@@ -1,0 +1,1 @@
+from ps_pytorch_tpu.data.datasets import prepare_data, DataLoader, DATASET_SHAPES  # noqa: F401

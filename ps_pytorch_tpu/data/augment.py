@@ -1,0 +1,76 @@
+"""Vectorized numpy augmentations reproducing the reference's torchvision
+transform stacks (``util.py:21-106``):
+
+- MNIST: normalize (0.1307, 0.3081)                       (util.py:24-33)
+- CIFAR-10/100 train: pad-4 reflect -> random crop 32 -> random hflip ->
+  normalize mean [125.3,123.0,113.9]/255, std [63.0,62.1,66.7]/255
+  (util.py:35-47, 61-74)
+- SVHN: random crop 32 pad 4 (zeros) -> hflip -> normalize
+  (0.4914,0.4822,0.4465)/(0.2023,0.1994,0.2010)           (util.py:89-101)
+
+All functions operate on NHWC uint8/float batches and are host-side (the
+per-step augmentation cost is hidden behind device compute by the prefetching
+loader in datasets.py).
+"""
+
+import numpy as np
+
+MNIST_MEAN, MNIST_STD = (0.1307,), (0.3081,)
+CIFAR_MEAN = np.array([125.3, 123.0, 113.9], np.float32) / 255.0
+CIFAR_STD = np.array([63.0, 62.1, 66.7], np.float32) / 255.0
+SVHN_MEAN = np.array([0.4914, 0.4822, 0.4465], np.float32)
+SVHN_STD = np.array([0.2023, 0.1994, 0.2010], np.float32)
+
+
+def normalize(x: np.ndarray, mean, std) -> np.ndarray:
+    """x: [..., C] float in [0,1] -> channel-normalized float32."""
+    return ((x - np.asarray(mean, np.float32)) / np.asarray(std, np.float32)).astype(np.float32)
+
+
+def random_crop(x: np.ndarray, rng: np.random.Generator, pad: int = 4,
+                mode: str = "reflect") -> np.ndarray:
+    """Per-image random crop back to the original HxW after padding.
+
+    mode='reflect' matches the CIFAR stack (util.py:39-43); mode='constant'
+    (zero pad) matches SVHN's RandomCrop(32, padding=4) (util.py:91).
+    """
+    b, h, w, c = x.shape
+    padded = np.pad(x, ((0, 0), (pad, pad), (pad, pad), (0, 0)), mode=mode)
+    out = np.empty_like(x)
+    ys = rng.integers(0, 2 * pad + 1, size=b)
+    xs = rng.integers(0, 2 * pad + 1, size=b)
+    for i in range(b):
+        out[i] = padded[i, ys[i]:ys[i] + h, xs[i]:xs[i] + w]
+    return out
+
+
+def random_hflip(x: np.ndarray, rng: np.random.Generator) -> np.ndarray:
+    flip = rng.random(x.shape[0]) < 0.5
+    x = x.copy()
+    x[flip] = x[flip, :, ::-1]
+    return x
+
+
+def augment_train(x: np.ndarray, dataset: str, rng: np.random.Generator) -> np.ndarray:
+    """Raw float batch in [0,1], NHWC -> augmented normalized float32 batch."""
+    if dataset == "MNIST":
+        return normalize(x, MNIST_MEAN, MNIST_STD)
+    if dataset in ("Cifar10", "Cifar100"):
+        x = random_crop(x, rng, pad=4, mode="reflect")
+        x = random_hflip(x, rng)
+        return normalize(x, CIFAR_MEAN, CIFAR_STD)
+    if dataset == "SVHN":
+        x = random_crop(x, rng, pad=4, mode="constant")
+        x = random_hflip(x, rng)
+        return normalize(x, SVHN_MEAN, SVHN_STD)
+    return x.astype(np.float32)  # synthetic
+
+
+def transform_test(x: np.ndarray, dataset: str) -> np.ndarray:
+    if dataset == "MNIST":
+        return normalize(x, MNIST_MEAN, MNIST_STD)
+    if dataset in ("Cifar10", "Cifar100"):
+        return normalize(x, CIFAR_MEAN, CIFAR_STD)
+    if dataset == "SVHN":
+        return normalize(x, SVHN_MEAN, SVHN_STD)
+    return x.astype(np.float32)

@@ -1,0 +1,33 @@
+"""LeNet for MNIST — TPU-native re-design of the reference LeNet
+(``model_ops/lenet.py:16-37``): conv(1->20, 5x5, valid) -> maxpool2 -> relu ->
+conv(20->50, 5x5, valid) -> maxpool2 -> relu -> fc(800->500) -> fc(500->classes).
+
+The reference's ``LeNetSplit`` variant (``lenet.py:39-258``) exists only to
+interleave per-layer backward with per-layer MPI sends; under XLA the compiler
+overlaps collectives with compute, so there is deliberately no split variant.
+"""
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class LeNet(nn.Module):
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        # x: [B, 28, 28, 1] NHWC
+        x = x.astype(self.dtype)
+        x = nn.Conv(20, (5, 5), padding="VALID", dtype=self.dtype, name="conv1")(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(x)
+        x = nn.Conv(50, (5, 5), padding="VALID", dtype=self.dtype, name="conv2")(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(x)
+        x = x.reshape((x.shape[0], -1))  # [B, 4*4*50]
+        x = nn.Dense(500, dtype=self.dtype, name="fc1")(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype, name="fc2")(x)
+        return x.astype(jnp.float32)

@@ -1,0 +1,107 @@
+"""CIFAR ResNet family — TPU-native re-design of the reference
+``model_ops/resnet.py`` (BasicBlock/Bottleneck ``:14-64``, stem+stages ``:67-97``,
+constructors ``:100-113``).
+
+Architecture parity: 3x3 stride-1 stem (no maxpool, CIFAR variant), stages
+[64,128,256,512] with strides [1,2,2,2], projection shortcut when shape
+changes, 4x4 average pool, linear head. BatchNorm semantics follow the
+reference: running stats are *replica-local* in distributed training (the
+reference excludes BN running stats from weight sync,
+``distributed_worker.py:245-252``); see parallel/dp.py for how that is
+reproduced on the mesh.
+
+NHWC layout, configurable compute dtype (bfloat16 for the MXU).
+"""
+
+from functools import partial
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+Conv = partial(nn.Conv, use_bias=False)
+
+
+class BasicBlock(nn.Module):
+    planes: int
+    stride: int = 1
+    dtype: Any = jnp.float32
+    expansion = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=self.dtype)
+        out = Conv(self.planes, (3, 3), strides=(self.stride, self.stride),
+                   padding=1, dtype=self.dtype)(x)
+        out = nn.relu(norm()(out))
+        out = Conv(self.planes, (3, 3), padding=1, dtype=self.dtype)(out)
+        out = norm()(out)
+        if self.stride != 1 or x.shape[-1] != self.planes * self.expansion:
+            x = Conv(self.planes * self.expansion, (1, 1),
+                     strides=(self.stride, self.stride), dtype=self.dtype)(x)
+            x = norm()(x)
+        return nn.relu(out + x)
+
+
+class Bottleneck(nn.Module):
+    planes: int
+    stride: int = 1
+    dtype: Any = jnp.float32
+    expansion = 4
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=self.dtype)
+        out = nn.relu(norm()(Conv(self.planes, (1, 1), dtype=self.dtype)(x)))
+        out = Conv(self.planes, (3, 3), strides=(self.stride, self.stride),
+                   padding=1, dtype=self.dtype)(out)
+        out = nn.relu(norm()(out))
+        out = Conv(self.planes * self.expansion, (1, 1), dtype=self.dtype)(out)
+        out = norm()(out)
+        if self.stride != 1 or x.shape[-1] != self.planes * self.expansion:
+            x = Conv(self.planes * self.expansion, (1, 1),
+                     strides=(self.stride, self.stride), dtype=self.dtype)(x)
+            x = norm()(x)
+        return nn.relu(out + x)
+
+
+class ResNet(nn.Module):
+    block: Any
+    num_blocks: Sequence[int]
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        # x: [B, 32, 32, 3] NHWC
+        x = x.astype(self.dtype)
+        x = Conv(64, (3, 3), padding=1, dtype=self.dtype, name="conv1")(x)
+        x = nn.relu(nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                                 epsilon=1e-5, dtype=self.dtype, name="bn1")(x))
+        for stage, (planes, n, stride) in enumerate(
+                zip((64, 128, 256, 512), self.num_blocks, (1, 2, 2, 2))):
+            for i in range(n):
+                x = self.block(planes, stride if i == 0 else 1,
+                               dtype=self.dtype)(x, train=train)
+        x = nn.avg_pool(x, (4, 4), strides=(4, 4))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(self.num_classes, dtype=self.dtype, name="linear")(x)
+        return x.astype(jnp.float32)
+
+
+def ResNet18(num_classes=10, dtype=jnp.float32):
+    return ResNet(BasicBlock, (2, 2, 2, 2), num_classes, dtype)
+
+def ResNet34(num_classes=10, dtype=jnp.float32):
+    return ResNet(BasicBlock, (3, 4, 6, 3), num_classes, dtype)
+
+def ResNet50(num_classes=10, dtype=jnp.float32):
+    return ResNet(Bottleneck, (3, 4, 6, 3), num_classes, dtype)
+
+def ResNet101(num_classes=10, dtype=jnp.float32):
+    return ResNet(Bottleneck, (3, 4, 23, 3), num_classes, dtype)
+
+def ResNet152(num_classes=10, dtype=jnp.float32):
+    return ResNet(Bottleneck, (3, 8, 36, 3), num_classes, dtype)

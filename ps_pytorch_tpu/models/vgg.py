@@ -1,0 +1,82 @@
+"""CIFAR VGG family — TPU-native re-design of the reference ``model_ops/vgg.py``
+(cfg table ``:62-68``, layer builder ``:46-59``, CIFAR-sized classifier head
+``:19-30``).
+
+Parity notes: convs keep bias even with BatchNorm (reference ``vgg.py:53-55``);
+classifier is Dropout -> 512 -> ReLU -> Dropout -> 512 -> ReLU -> num_classes;
+conv weights use He-normal init fan-out style (reference ``vgg.py:32-36``
+``normal_(0, sqrt(2/n))`` with n = k*k*out_channels).
+"""
+
+from typing import Any, Sequence, Union
+
+import flax.linen as nn
+import jax.numpy as jnp
+from jax.nn.initializers import variance_scaling
+
+# He-style init over fan_out = k*k*out_channels, matching vgg.py:32-36.
+conv_init = variance_scaling(2.0, "fan_out", "normal")
+
+CFG = {
+    "A": (64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"),
+    "B": (64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"),
+    "D": (64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512, "M",
+          512, 512, 512, "M"),
+    "E": (64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
+          512, 512, 512, 512, "M", 512, 512, 512, 512, "M"),
+}
+
+
+class VGG(nn.Module):
+    cfg: Sequence[Union[int, str]]
+    batch_norm: bool = False
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        # x: [B, 32, 32, 3] NHWC
+        x = x.astype(self.dtype)
+        for v in self.cfg:
+            if v == "M":
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            else:
+                x = nn.Conv(v, (3, 3), padding=1, dtype=self.dtype,
+                            kernel_init=conv_init)(x)
+                if self.batch_norm:
+                    x = nn.BatchNorm(use_running_average=not train,
+                                     momentum=0.9, epsilon=1e-5,
+                                     dtype=self.dtype)(x)
+                x = nn.relu(x)
+        x = x.reshape((x.shape[0], -1))  # [B, 512] after 5 pools on 32x32
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.relu(nn.Dense(512, dtype=self.dtype)(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.relu(nn.Dense(512, dtype=self.dtype)(x))
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)
+
+
+def VGG11(num_classes=10, dtype=jnp.float32):
+    return VGG(CFG["A"], False, num_classes, dtype)
+
+def VGG13(num_classes=10, dtype=jnp.float32):
+    return VGG(CFG["B"], False, num_classes, dtype)
+
+def VGG16(num_classes=10, dtype=jnp.float32):
+    return VGG(CFG["D"], False, num_classes, dtype)
+
+def VGG19(num_classes=10, dtype=jnp.float32):
+    return VGG(CFG["E"], False, num_classes, dtype)
+
+def VGG11_BN(num_classes=10, dtype=jnp.float32):
+    return VGG(CFG["A"], True, num_classes, dtype)
+
+def VGG13_BN(num_classes=10, dtype=jnp.float32):
+    return VGG(CFG["B"], True, num_classes, dtype)
+
+def VGG16_BN(num_classes=10, dtype=jnp.float32):
+    return VGG(CFG["D"], True, num_classes, dtype)
+
+def VGG19_BN(num_classes=10, dtype=jnp.float32):
+    return VGG(CFG["E"], True, num_classes, dtype)

@@ -1,0 +1,59 @@
+"""Adam / AMSGrad matching the reference's torch fork exactly
+(``optim/adam.py:38-94``):
+
+    t <- t + 1
+    g  = g + wd * p                               # (:75-76)
+    m  = b1*m + (1-b1)*g                          # (:79)
+    v  = b2*v + (1-b2)*g*g                        # (:80)
+    vhat = max(vhat, v) if amsgrad else v         # (:81-87)
+    denom = sqrt(vhat) + eps                      # eps OUTSIDE the sqrt, torch-style
+    step_size = lr * sqrt(1-b2^t) / (1-b1^t)      # (:89-91)
+    p <- p - step_size * m / denom                # (:93)
+"""
+
+from typing import Callable, NamedTuple, Union
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class AdamState(NamedTuple):
+    step: jnp.ndarray
+    exp_avg: optax.Params
+    exp_avg_sq: optax.Params
+    max_exp_avg_sq: optax.Params   # () when amsgrad is off
+
+
+def adam(lr: Union[float, Callable] = 1e-3, b1: float = 0.9, b2: float = 0.999,
+         eps: float = 1e-8, weight_decay: float = 0.0,
+         amsgrad: bool = False) -> optax.GradientTransformation:
+
+    def init(params):
+        z = jax.tree.map(jnp.zeros_like, params)
+        return AdamState(step=jnp.zeros((), jnp.int32), exp_avg=z,
+                         exp_avg_sq=jax.tree.map(jnp.zeros_like, params),
+                         max_exp_avg_sq=jax.tree.map(jnp.zeros_like, params) if amsgrad else ())
+
+    def update(grads, state, params=None):
+        if weight_decay != 0:
+            if params is None:
+                raise ValueError("weight_decay requires params")
+            grads = jax.tree.map(lambda g, p: g + weight_decay * p, grads, params)
+        t = state.step + 1
+        m = jax.tree.map(lambda m_, g: b1 * m_ + (1 - b1) * g, state.exp_avg, grads)
+        v = jax.tree.map(lambda v_, g: b2 * v_ + (1 - b2) * g * g, state.exp_avg_sq, grads)
+        if amsgrad:
+            vhat = jax.tree.map(jnp.maximum, state.max_exp_avg_sq, v)
+            denom_src = vhat
+        else:
+            vhat = ()
+            denom_src = v
+        tf = t.astype(jnp.float32)
+        lr_t = lr(state.step) if callable(lr) else lr
+        step_size = lr_t * jnp.sqrt(1 - b2 ** tf) / (1 - b1 ** tf)
+        updates = jax.tree.map(
+            lambda m_, v_: -step_size * m_ / (jnp.sqrt(v_) + eps), m, denom_src)
+        return updates, AdamState(step=t, exp_avg=m, exp_avg_sq=v, max_exp_avg_sq=vhat)
+
+    return optax.GradientTransformation(init, update)

@@ -1,0 +1,60 @@
+"""SGD with momentum/dampening/Nesterov/weight-decay, matching the reference's
+torch fork exactly (``optim/sgd.py:59-91``):
+
+    d_p = g + wd * p
+    step 0:  buf = d_p                       # zeros*mu + d_p, no dampening (:82-83)
+    step>0:  buf = mu * buf + (1-damp) * d_p  # (:85-86)
+    nesterov: d = d_p + mu * buf             # (:87-88)
+    else:     d = buf
+    p <- p - lr * d                          # (:91)
+
+Implemented as an optax GradientTransformation whose ``update`` returns the
+additive delta (-lr * d), so it composes with ``optax.apply_updates`` and runs
+replicated inside the jitted SPMD step. ``lr`` may be a float or a
+``step -> lr`` schedule callable.
+"""
+
+from typing import Callable, NamedTuple, Union
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class SGDState(NamedTuple):
+    step: jnp.ndarray          # int32 scalar
+    momentum: optax.Params     # momentum buffers (empty tuple if momentum==0)
+
+
+def sgd(lr: Union[float, Callable], momentum: float = 0.0, dampening: float = 0.0,
+        weight_decay: float = 0.0, nesterov: bool = False) -> optax.GradientTransformation:
+    if nesterov and (momentum <= 0 or dampening != 0):
+        raise ValueError("Nesterov momentum requires a momentum and zero dampening")
+
+    def init(params):
+        buf = jax.tree.map(jnp.zeros_like, params) if momentum != 0 else ()
+        return SGDState(step=jnp.zeros((), jnp.int32), momentum=buf)
+
+    def update(grads, state, params=None):
+        if weight_decay != 0:
+            if params is None:
+                raise ValueError("weight_decay requires params")
+            grads = jax.tree.map(lambda g, p: g + weight_decay * p, grads, params)
+        lr_t = lr(state.step) if callable(lr) else lr
+        if momentum != 0:
+            first = state.step == 0
+            buf = jax.tree.map(
+                lambda b, d: jnp.where(first, d, momentum * b + (1 - dampening) * d),
+                state.momentum, grads)
+            if nesterov:
+                d = jax.tree.map(lambda dp, b: dp + momentum * b, grads, buf)
+            else:
+                d = buf
+            new_state = SGDState(step=state.step + 1, momentum=buf)
+        else:
+            d = grads
+            new_state = SGDState(step=state.step + 1, momentum=())
+        updates = jax.tree.map(lambda x: -lr_t * x, d)
+        return updates, new_state
+
+    return optax.GradientTransformation(init, update)

@@ -1,0 +1,2 @@
+from ps_pytorch_tpu.parallel.mesh import make_mesh  # noqa: F401
+from ps_pytorch_tpu.parallel.dp import TrainState, create_train_state, make_train_step, make_eval_step  # noqa: F401

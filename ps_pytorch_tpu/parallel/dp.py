@@ -1,0 +1,173 @@
+"""The SPMD data-parallel step — the heart of the framework.
+
+Replaces the reference's entire per-step wire protocol (SURVEY §2.3): weight
+broadcast (``sync_replicas_master_nn.py:218-225``), per-layer gradient upload
+(``distributed_worker.py:254-272``), master-side Waitany aggregation with
+backup-worker cutoff (``sync_replicas_master_nn.py:156-186``) and the
+master-side optimizer step (``:204-208``) — with ONE jitted ``shard_map`` over
+the ('data','model') mesh:
+
+- parameters + optimizer state are mesh-replicated; "weight broadcast"
+  ceases to exist as communication;
+- gradients are averaged in-graph with a masked ``psum`` riding ICI;
+- the K-of-N backup-worker capability (`--num-aggregate`,
+  ``sync_replicas_master_nn.py:116,179``) becomes a per-replica participation
+  mask: contributions are weighted, summed with ``psum``, and divided by the
+  participating count — replicas excluded by the coordinator's deadline policy
+  (runtime/coordinator.py) contribute nothing, yet every replica still ends
+  the step with identical parameters;
+- BatchNorm running statistics stay replica-local, exactly like the reference
+  (workers exclude BN running stats from weight sync,
+  ``distributed_worker.py:245-252``): ``batch_stats`` leaves carry a leading
+  [n_data] axis sharded over the data axis. ``sync_batchnorm=True`` opts into
+  cross-replica stat averaging instead.
+"""
+
+from functools import partial
+from typing import Any, Callable, Optional
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+class TrainState(flax.struct.PyTreeNode):
+    step: jnp.ndarray              # int32 scalar, replicated
+    params: Any                    # replicated
+    opt_state: Any                 # replicated
+    batch_stats: Any               # leading [n_data] axis, sharded over 'data'; {} if no BN
+
+
+def _model_collections(model, sample_shape, rng):
+    variables = model.init(rng, jnp.zeros(sample_shape, jnp.float32), train=False)
+    return variables["params"], variables.get("batch_stats", {})
+
+
+def create_train_state(model, tx: optax.GradientTransformation, mesh: Mesh,
+                       sample_shape, rng) -> TrainState:
+    """Initialize replicated params/opt_state and per-replica batch_stats,
+    placed with the shardings make_train_step expects."""
+    n_data = mesh.shape["data"]
+    params, batch_stats = _model_collections(model, sample_shape, rng)
+    opt_state = tx.init(params)
+    batch_stats = jax.tree.map(
+        lambda a: jnp.tile(a[None], (n_data,) + (1,) * a.ndim), batch_stats)
+    state = TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                       opt_state=opt_state, batch_stats=batch_stats)
+    return jax.device_put(state, state_shardings(mesh, state))
+
+
+def state_specs(state: TrainState) -> TrainState:
+    """PartitionSpec pytree (prefix form) matching TrainState placement."""
+    return TrainState(
+        step=P(),
+        params=jax.tree.map(lambda _: P(), state.params),
+        opt_state=jax.tree.map(lambda _: P(), state.opt_state),
+        batch_stats=jax.tree.map(lambda _: P("data"), state.batch_stats),
+    )
+
+
+def state_shardings(mesh: Mesh, state: TrainState) -> TrainState:
+    return jax.tree.map(lambda s: NamedSharding(mesh, s), state_specs(state),
+                        is_leaf=lambda x: isinstance(x, P))
+
+
+def make_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
+                    state: TrainState, *, sync_batchnorm: bool = False,
+                    remat: bool = False, donate: bool = True) -> Callable:
+    """Build the jitted SPMD train step.
+
+    Returns ``step_fn(state, x, y, mask, rng) -> (state, metrics)`` where
+      x: [B, H, W, C] global batch (sharded over 'data'),
+      y: [B] int labels,
+      mask: [n_data] float participation vector (K-of-N; all-ones = sync mode),
+      rng: scalar PRNG key (per-replica dropout keys are folded in-graph).
+    metrics: dict of replicated scalars (loss, accuracy, participating).
+    """
+    has_bn = bool(jax.tree.leaves(state.batch_stats))
+
+    def loss_fn(params, bs_local, x, y, rng):
+        variables = {"params": params}
+        if has_bn:
+            variables["batch_stats"] = bs_local
+        # Unused rngs are ignored by flax, so pass dropout unconditionally.
+        kw = dict(train=True, rngs={"dropout": rng})
+        if has_bn:
+            logits, mut = model.apply(variables, x, mutable=["batch_stats"], **kw)
+            new_bs = mut["batch_stats"]
+        else:
+            logits = model.apply(variables, x, **kw)
+            new_bs = bs_local
+        loss = optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+        acc = jnp.mean(jnp.argmax(logits, -1) == y)
+        return loss, (new_bs, acc)
+
+    vg = jax.value_and_grad(
+        jax.checkpoint(loss_fn) if remat else loss_fn, has_aux=True)
+
+    def local_step(state, x, y, mask, rng):
+        # Runs per-replica inside shard_map; x/y/mask are the local shards.
+        bs_local = jax.tree.map(lambda a: a[0], state.batch_stats)
+        rng = jax.random.fold_in(rng, jax.lax.axis_index("data"))
+        (loss, (new_bs, acc)), grads = vg(state.params, bs_local, x, y, rng)
+        m = mask[0]
+        # Masked mean over participating replicas == "aggregate the first K
+        # arrivals then divide by K" (sync_replicas_master_nn.py:179,204-208).
+        msum = jax.lax.psum(m, "data")
+        denom = jnp.maximum(msum, 1.0)
+        gavg = jax.tree.map(
+            lambda g: jax.lax.psum(g * m, "data") / denom, grads)
+        updates, new_opt = tx.update(gavg, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        if has_bn and sync_batchnorm:
+            # Masked mean: replicas excluded by K-of-N must not contaminate
+            # the synced stats (same discipline as the gradient path).
+            new_bs = jax.tree.map(
+                lambda a: jax.lax.psum(a * m, "data") / denom, new_bs)
+        metrics = {
+            "loss": jax.lax.psum(loss * m, "data") / denom,
+            "accuracy": jax.lax.psum(acc * m, "data") / denom,
+            "participating": msum,
+        }
+        new_state = state.replace(
+            step=state.step + 1, params=new_params, opt_state=new_opt,
+            batch_stats=jax.tree.map(lambda a: a[None], new_bs))
+        return new_state, metrics
+
+    specs = state_specs(state)
+    sharded = jax.shard_map(
+        local_step, mesh=mesh,
+        in_specs=(specs, P("data"), P("data"), P("data"), P()),
+        out_specs=(specs, P()),
+        check_vma=False)
+    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+
+
+def make_eval_step(model) -> Callable:
+    """Jitted single-shard eval: (params, batch_stats_local, x, y) ->
+    dict(sum_loss, top1, top5, count). The evaluator feeds replica-0 batch
+    stats, mirroring the reference evaluator consuming a single worker's
+    checkpoint (``distributed_evaluator.py:90-106``)."""
+
+    @jax.jit
+    def eval_step(params, batch_stats, x, y):
+        variables = {"params": params}
+        if jax.tree.leaves(batch_stats):
+            variables["batch_stats"] = batch_stats
+        logits = model.apply(variables, x, train=False)
+        loss = optax.softmax_cross_entropy_with_integer_labels(logits, y)
+        top1 = (jnp.argmax(logits, -1) == y).sum()
+        top5 = (jax.lax.top_k(logits, 5)[1] == y[:, None]).any(-1).sum()
+        return {"sum_loss": loss.sum(), "top1": top1, "top5": top5,
+                "count": jnp.asarray(y.shape[0], jnp.int32)}
+
+    return eval_step
+
+
+def replica0_batch_stats(state: TrainState):
+    """Pull one replica's BN stats to the host (for eval/checkpoint), matching
+    the reference's 'a worker checkpoints its local BN stats' behavior
+    (``distributed_worker.py:175-177``)."""
+    return jax.tree.map(lambda a: a[0], state.batch_stats)

@@ -1,0 +1,43 @@
+"""Device-mesh construction.
+
+The communication fabric of the framework: where the reference wires every
+process into MPI_COMM_WORLD and hand-rolls a tag protocol over it (SURVEY
+§2.3), here all per-step communication is expressed as XLA collectives over a
+``jax.sharding.Mesh`` and compiled into the step. The mesh is N-dimensional
+from day one — ``('data', 'model')`` — so tensor/sequence axes can be added
+without re-architecting (SURVEY §5.7), even though the reference's CNN
+workloads only exercise the data axis.
+"""
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def make_mesh(data: int = 0, model: int = 1,
+              devices: Optional[Sequence] = None) -> Mesh:
+    """Build a ('data', 'model') mesh.
+
+    data=0 means "all available devices / model". On real hardware the device
+    order from ``jax.devices()`` already follows the ICI topology, so
+    contiguous reshape keeps collectives on ICI neighbors.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if data == 0:
+        if n % model:
+            raise ValueError(f"{n} devices not divisible by model={model}")
+        data = n // model
+    need = data * model
+    if need > n:
+        raise ValueError(f"mesh {data}x{model} needs {need} devices, have {n}")
+    arr = np.array(devices[:need]).reshape(data, model)
+    return Mesh(arr, ("data", "model"))
+
+
+def local_data_shard() -> tuple:
+    """(host_id, num_hosts) for per-host input sharding along the data axis;
+    feed these to ``prepare_data(cfg, host_id, num_hosts)``."""
+    return jax.process_index(), jax.process_count()

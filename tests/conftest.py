@@ -1,0 +1,32 @@
+"""Test harness: fake 8-device CPU mesh (SURVEY §4 'implication for the new
+build') — the standard JAX mechanism for exercising multi-device collective
+code without TPUs. Must run before jax initializes its backends."""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+# The axon TPU plugin (sitecustomize) force-sets jax_platforms="axon,cpu";
+# override it back to CPU-only before any backend initializes.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    from ps_pytorch_tpu.parallel import make_mesh
+    return make_mesh(data=8)
+
+
+@pytest.fixture()
+def rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,60 @@
+"""Data pipeline tests: sharding disjointness (data-locality parity,
+README.md:24), augmentation shapes/determinism, persistent next_batch."""
+
+import numpy as np
+
+from ps_pytorch_tpu.config import TrainConfig
+from ps_pytorch_tpu.data import DataLoader, prepare_data
+from ps_pytorch_tpu.data.augment import augment_train, random_crop, transform_test
+
+
+def test_prepare_data_synthetic():
+    cfg = TrainConfig(dataset="synthetic", batch_size=64, test_batch_size=100)
+    train, test = prepare_data(cfg)
+    xb, yb = next(train.epoch(0))
+    assert xb.shape == (64, 32, 32, 3) and xb.dtype == np.float32
+    assert yb.shape == (64,) and yb.dtype == np.int32
+
+
+def test_host_shards_disjoint():
+    cfg = TrainConfig(dataset="synthetic", batch_size=64)
+    x = np.arange(1000, dtype=np.float32)[:, None, None, None] * np.ones((1, 4, 4, 1), np.float32)
+    y = np.arange(1000, dtype=np.int32)
+    loaders = [DataLoader(x, y, 100, "synthetic", train=True, seed=7,
+                          host_id=h, num_hosts=4) for h in range(4)]
+    seen = [set(int(v) for _, yb in ld.epoch(0) for v in yb) for ld in loaders]
+    for a in range(4):
+        for b in range(a + 1, 4):
+            assert not (seen[a] & seen[b]), "host shards overlap"
+    assert len(set().union(*seen)) == 1000
+
+
+def test_augment_cifar_shapes(rng):
+    x = rng.random((8, 32, 32, 3), dtype=np.float32)
+    out = augment_train(x, "Cifar10", np.random.default_rng(0))
+    assert out.shape == x.shape and out.dtype == np.float32
+    # Normalization applied: values leave [0,1].
+    assert out.min() < 0
+
+
+def test_random_crop_reflect_identity_possible(rng):
+    x = rng.random((4, 8, 8, 1), dtype=np.float32)
+    out = random_crop(x, np.random.default_rng(0), pad=2, mode="reflect")
+    assert out.shape == x.shape
+
+
+def test_mnist_normalize_matches_reference():
+    # util.py:24-27: Normalize((0.1307,), (0.3081,)).
+    x = np.full((1, 28, 28, 1), 0.1307, np.float32)
+    out = transform_test(x, "MNIST")
+    np.testing.assert_allclose(out, 0.0, atol=1e-6)
+
+
+def test_next_batch_advances_epochs():
+    cfg = TrainConfig(dataset="synthetic", batch_size=25000)
+    train, _ = prepare_data(cfg)
+    n = len(train)
+    assert n == 2
+    for _ in range(5):  # crosses epoch boundaries without StopIteration
+        xb, yb = train.next_batch()
+        assert xb.shape[0] == 25000

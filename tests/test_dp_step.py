@@ -1,0 +1,127 @@
+"""SPMD data-parallel step tests on the fake 8-device CPU mesh.
+
+Covers: psum gradient averaging == single-device large-batch step; K-of-N
+participation masking (backup-worker semantics,
+sync_replicas_master_nn.py:116,179); replica-local BatchNorm stats
+(distributed_worker.py:245-252)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ps_pytorch_tpu.models import build_model
+from ps_pytorch_tpu.optim import sgd
+from ps_pytorch_tpu.parallel import (
+    TrainState, create_train_state, make_eval_step, make_train_step,
+)
+from ps_pytorch_tpu.parallel.dp import replica0_batch_stats
+
+
+def _setup(mesh8, name="LeNet", shape=(16, 28, 28, 1), lr=0.1, momentum=0.9):
+    model = build_model(name)
+    tx = sgd(lr=lr, momentum=momentum)
+    state = create_train_state(model, tx, mesh8, (1,) + shape[1:],
+                               jax.random.key(0))
+    step_fn = make_train_step(model, tx, mesh8, state, donate=False)
+    return model, tx, state, step_fn
+
+
+def test_dp_matches_single_device(mesh8):
+    """8-way psum-averaged step == single-device step on the full batch."""
+    model, tx, state, step_fn = _setup(mesh8)
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(16, 28, 28, 1)).astype(np.float32)
+    y = rng.integers(0, 10, 16).astype(np.int32)
+    mask = np.ones(8, np.float32)
+    new_state, metrics = step_fn(state, jnp.asarray(x), jnp.asarray(y),
+                                 jnp.asarray(mask), jax.random.key(0))
+
+    # Single-device reference: mean over the 8 shard-losses == psum/8.
+    def total_loss(params):
+        import optax
+        shard_losses = []
+        for i in range(8):
+            logits = model.apply({"params": params}, x[i * 2:(i + 1) * 2], train=True)
+            shard_losses.append(optax.softmax_cross_entropy_with_integer_labels(
+                logits, y[i * 2:(i + 1) * 2]).mean())
+        return jnp.mean(jnp.stack(shard_losses))
+
+    g = jax.grad(total_loss)(state.params)
+    import optax
+    updates, _ = tx.update(g, tx.init(state.params), state.params)
+    want = optax.apply_updates(state.params, updates)
+    for a, b in zip(jax.tree.leaves(new_state.params), jax.tree.leaves(want)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5)
+    assert int(new_state.step) == 1
+    assert float(metrics["participating"]) == 8.0
+
+
+def test_kofn_masking(mesh8):
+    """Masked-out replicas contribute nothing: K-of-N == K-replica mean."""
+    model, tx, state, step_fn = _setup(mesh8, momentum=0.0)
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(16, 28, 28, 1)).astype(np.float32)
+    y = rng.integers(0, 10, 16).astype(np.int32)
+    mask = np.array([1, 1, 1, 1, 1, 0, 0, 0], np.float32)  # K=5 of N=8
+    new_state, metrics = step_fn(state, jnp.asarray(x), jnp.asarray(y),
+                                 jnp.asarray(mask), jax.random.key(0))
+    assert float(metrics["participating"]) == 5.0
+
+    def k_loss(params):
+        import optax
+        shard_losses = []
+        for i in range(5):
+            logits = model.apply({"params": params}, x[i * 2:(i + 1) * 2], train=True)
+            shard_losses.append(optax.softmax_cross_entropy_with_integer_labels(
+                logits, y[i * 2:(i + 1) * 2]).mean())
+        return jnp.mean(jnp.stack(shard_losses))
+
+    g = jax.grad(k_loss)(state.params)
+    import optax
+    updates, _ = tx.update(g, tx.init(state.params), state.params)
+    want = optax.apply_updates(state.params, updates)
+    for a, b in zip(jax.tree.leaves(new_state.params), jax.tree.leaves(want)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5)
+
+
+def test_local_batchnorm_stats_diverge(mesh8):
+    """Replica-local BN: different data shards -> different running stats,
+    identical params (reference semantics, distributed_worker.py:245-252)."""
+    model, tx, state, step_fn = _setup(
+        mesh8, name="ResNet18", shape=(16, 32, 32, 3), momentum=0.9)
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(16, 32, 32, 3)).astype(np.float32)
+    # Make shard 0 statistically different from shard 7.
+    x[:2] *= 5.0
+    y = rng.integers(0, 10, 16).astype(np.int32)
+    new_state, _ = step_fn(state, jnp.asarray(x), jnp.asarray(y),
+                           jnp.ones(8, jnp.float32), jax.random.key(0))
+    leaf = jax.tree.leaves(new_state.batch_stats)[0]
+    assert leaf.shape[0] == 8
+    assert not np.allclose(np.asarray(leaf[0]), np.asarray(leaf[7]))
+
+
+def test_sync_batchnorm_option(mesh8):
+    model = build_model("ResNet18")
+    tx = sgd(lr=0.1)
+    state = create_train_state(model, tx, mesh8, (1, 32, 32, 3), jax.random.key(0))
+    step_fn = make_train_step(model, tx, mesh8, state, sync_batchnorm=True, donate=False)
+    rng = np.random.default_rng(4)
+    x = rng.normal(size=(16, 32, 32, 3)).astype(np.float32)
+    y = rng.integers(0, 10, 16).astype(np.int32)
+    new_state, _ = step_fn(state, jnp.asarray(x), jnp.asarray(y),
+                           jnp.ones(8, jnp.float32), jax.random.key(0))
+    leaf = jax.tree.leaves(new_state.batch_stats)[0]
+    np.testing.assert_allclose(np.asarray(leaf[0]), np.asarray(leaf[7]), rtol=1e-5)
+
+
+def test_eval_step(mesh8):
+    model, tx, state, step_fn = _setup(mesh8)
+    eval_fn = make_eval_step(model)
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(32, 28, 28, 1)).astype(np.float32)
+    y = rng.integers(0, 10, 32).astype(np.int32)
+    m = eval_fn(state.params, replica0_batch_stats(state),
+                jnp.asarray(x), jnp.asarray(y))
+    assert int(m["count"]) == 32
+    assert 0 <= int(m["top1"]) <= int(m["top5"]) <= 32

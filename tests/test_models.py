@@ -1,0 +1,66 @@
+"""Model zoo shape/param-count tests (reference architecture parity,
+model_ops/lenet.py:16-37, model_ops/resnet.py, model_ops/vgg.py)."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from ps_pytorch_tpu.models import build_model, model_names
+
+
+def _init_and_apply(name, shape, num_classes=10):
+    model = build_model(name, num_classes)
+    x = jnp.zeros(shape, jnp.float32)
+    variables = model.init(jax.random.key(0), x, train=False)
+    out = model.apply(variables, x, train=False)
+    return variables, out
+
+
+def n_params(params):
+    return sum(p.size for p in jax.tree.leaves(params))
+
+
+def test_lenet_shapes():
+    variables, out = _init_and_apply("LeNet", (2, 28, 28, 1))
+    assert out.shape == (2, 10)
+    # Reference LeNet (lenet.py:19-22): conv1 1*20*25+20, conv2 20*50*25+50,
+    # fc1 800*500+500, fc2 500*10+10 = 431080.
+    assert n_params(variables["params"]) == 431080
+
+
+def test_resnet18_shapes():
+    variables, out = _init_and_apply("ResNet18", (2, 32, 32, 3))
+    assert out.shape == (2, 10)
+    # Torch CIFAR ResNet-18 has 11,173,962 params for 10 classes.
+    assert n_params(variables["params"]) == 11173962
+    assert "batch_stats" in variables
+
+
+def test_resnet50_forward():
+    variables, out = _init_and_apply("ResNet50", (1, 32, 32, 3))
+    assert out.shape == (1, 10)
+    assert n_params(variables["params"]) == 23520842
+
+
+def test_vgg11_bn():
+    variables, out = _init_and_apply("VGG11", (2, 32, 32, 3))
+    assert out.shape == (2, 10)
+    # Reference vgg11_bn CIFAR head (vgg.py:19-30): 9,756,426 params.
+    assert n_params(variables["params"]) == 9756426
+
+
+def test_vgg_num_classes():
+    _, out = _init_and_apply("VGG11", (1, 32, 32, 3), num_classes=100)
+    assert out.shape == (1, 100)
+
+
+def test_registry_covers_reference_families():
+    names = model_names()
+    for required in ["LeNet", "ResNet18", "ResNet34", "ResNet50", "ResNet101",
+                     "ResNet152", "VGG11", "VGG13", "VGG16", "VGG19"]:
+        assert required in names
+
+
+def test_unknown_model_raises():
+    with pytest.raises(ValueError):
+        build_model("AlexNet")

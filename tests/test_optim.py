@@ -1,0 +1,102 @@
+"""Golden tests: optimizer math vs a numpy transcription of the reference's
+torch forks (optim/sgd.py:59-91, optim/adam.py:38-94)."""
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ps_pytorch_tpu.optim import adam, sgd
+
+
+def ref_sgd_steps(p0, grads_seq, lr, momentum=0.0, dampening=0.0,
+                  weight_decay=0.0, nesterov=False):
+    """Numpy transcription of the reference step() (optim/sgd.py:69-91)."""
+    p = p0.copy()
+    buf = None
+    for g in grads_seq:
+        d_p = g.copy()
+        if weight_decay != 0:
+            d_p += weight_decay * p
+        if momentum != 0:
+            if buf is None:
+                buf = np.zeros_like(p)
+                buf = buf * momentum + d_p          # sgd.py:82-83
+            else:
+                buf = buf * momentum + (1 - dampening) * d_p  # :85-86
+            d_p = d_p + momentum * buf if nesterov else buf
+        p = p - lr * d_p
+    return p
+
+
+def ref_adam_steps(p0, grads_seq, lr=1e-3, b1=0.9, b2=0.999, eps=1e-8,
+                   weight_decay=0.0, amsgrad=False):
+    """Numpy transcription of the reference step() (optim/adam.py:48-93)."""
+    p = p0.copy()
+    m = np.zeros_like(p)
+    v = np.zeros_like(p)
+    vmax = np.zeros_like(p)
+    t = 0
+    for g in grads_seq:
+        t += 1
+        g = g + weight_decay * p if weight_decay != 0 else g
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        if amsgrad:
+            vmax = np.maximum(vmax, v)
+            denom = np.sqrt(vmax) + eps
+        else:
+            denom = np.sqrt(v) + eps
+        step_size = lr * np.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+        p = p - step_size * m / denom
+    return p
+
+
+def run_tx(tx, p0, grads_seq):
+    params = {"w": jnp.asarray(p0)}
+    state = tx.init(params)
+    for g in grads_seq:
+        updates, state = tx.update({"w": jnp.asarray(g)}, state, params)
+        params = optax.apply_updates(params, updates)
+    return np.asarray(params["w"])
+
+
+def test_sgd_plain(rng):
+    p0 = rng.normal(size=(7,)).astype(np.float32)
+    gs = [rng.normal(size=(7,)).astype(np.float32) for _ in range(5)]
+    got = run_tx(sgd(lr=0.1), p0, gs)
+    np.testing.assert_allclose(got, ref_sgd_steps(p0, gs, 0.1), rtol=1e-6)
+
+
+def test_sgd_momentum_wd(rng):
+    p0 = rng.normal(size=(4, 3)).astype(np.float32)
+    gs = [rng.normal(size=(4, 3)).astype(np.float32) for _ in range(6)]
+    got = run_tx(sgd(lr=0.05, momentum=0.9, weight_decay=1e-4), p0, gs)
+    want = ref_sgd_steps(p0, gs, 0.05, momentum=0.9, weight_decay=1e-4)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=1e-5)
+
+
+def test_sgd_nesterov_dampening_firststep(rng):
+    # First-step special case: buf = d_p even with dampening (sgd.py:82-83).
+    p0 = rng.normal(size=(5,)).astype(np.float32)
+    gs = [rng.normal(size=(5,)).astype(np.float32) for _ in range(4)]
+    got = run_tx(sgd(lr=0.1, momentum=0.5, nesterov=True), p0, gs)
+    want = ref_sgd_steps(p0, gs, 0.1, momentum=0.5, nesterov=True)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=1e-5)
+    got_d = run_tx(sgd(lr=0.1, momentum=0.9, dampening=0.3), p0, gs)
+    want_d = ref_sgd_steps(p0, gs, 0.1, momentum=0.9, dampening=0.3)
+    np.testing.assert_allclose(got_d, want_d, rtol=1e-5, atol=1e-6)
+
+
+def test_adam(rng):
+    p0 = rng.normal(size=(9,)).astype(np.float32)
+    gs = [rng.normal(size=(9,)).astype(np.float32) for _ in range(7)]
+    got = run_tx(adam(lr=1e-2), p0, gs)
+    np.testing.assert_allclose(got, ref_adam_steps(p0, gs, 1e-2), rtol=2e-4, atol=1e-5)
+
+
+def test_adam_amsgrad_wd(rng):
+    p0 = rng.normal(size=(9,)).astype(np.float32)
+    gs = [rng.normal(size=(9,)).astype(np.float32) for _ in range(7)]
+    got = run_tx(adam(lr=1e-2, weight_decay=1e-3, amsgrad=True), p0, gs)
+    want = ref_adam_steps(p0, gs, 1e-2, weight_decay=1e-3, amsgrad=True)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=1e-5)
