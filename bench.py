@@ -1,0 +1,80 @@
+#!/usr/bin/env python
+"""Headline benchmark: ResNet-18 / CIFAR-10-shaped training throughput.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline derivation (vs_baseline): the reference publishes no absolute
+throughput (BASELINE.md); its headline distributed config is ResNet-18 /
+CIFAR-10 on 8 MPI workers (m4.2xlarge CPUs) at a 5.19x speedup over 1 worker
+(BASELINE.md, b=1024 "normal" speedup row). A single m4.2xlarge (8-vCPU
+Broadwell Xeon) sustains ~80 images/sec on ResNet-18/CIFAR-10 training in
+that era's PyTorch — so the 8-worker MPI cluster's effective rate is
+~80 * 5.19 ~= 415 images/sec. BASELINE.json's target is >=20x that rate
+(>= 8,300 img/s). vs_baseline reported here = measured / 415.
+
+Runs on whatever jax.devices() provides (the real TPU chip under the driver;
+CPU elsewhere). Synthetic CIFAR-shaped data — this measures the training
+step (forward+backward+psum+update), not host input I/O.
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+BASELINE_IMGS_PER_SEC = 415.0  # 8-worker m4.2xlarge MPI cluster, see docstring
+
+
+def main() -> None:
+    from ps_pytorch_tpu.config import TrainConfig
+    from ps_pytorch_tpu.models import build_model
+    from ps_pytorch_tpu.optim import build_optimizer
+    from ps_pytorch_tpu.parallel import (
+        create_train_state, make_mesh, make_train_step,
+    )
+
+    n_dev = len(jax.devices())
+    batch = 1024 * n_dev
+    cfg = TrainConfig(dataset="Cifar10", network="ResNet18", batch_size=batch,
+                      lr=0.1, momentum=0.9, weight_decay=1e-4,
+                      compute_dtype="bfloat16")
+    mesh = make_mesh(data=n_dev)
+    model = build_model(cfg.network, cfg.num_classes, cfg.compute_dtype)
+    tx = build_optimizer(cfg)
+    state = create_train_state(model, tx, mesh, (1, 32, 32, 3), jax.random.key(0))
+    step_fn = make_train_step(model, tx, mesh, state, donate=True)
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(batch, 32, 32, 3)).astype(np.float32))
+    y = jnp.asarray(rng.integers(0, 10, batch).astype(np.int32))
+    mask = jnp.ones(n_dev, jnp.float32)
+
+    # Warmup (compile) then timed steps. Materialize a scalar each phase —
+    # on the axon remote platform, block_until_ready alone has been observed
+    # to return before the dispatched chain finishes.
+    for i in range(3):
+        state, metrics = step_fn(state, x, y, mask, jax.random.key(i))
+    _ = float(metrics["loss"])
+    jax.block_until_ready(state.params)
+
+    steps = 20
+    t0 = time.perf_counter()
+    for i in range(steps):
+        state, metrics = step_fn(state, x, y, mask, jax.random.key(100 + i))
+    jax.block_until_ready(state.params)
+    _ = float(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    imgs_per_sec = steps * batch / dt
+    print(json.dumps({
+        "metric": "resnet18_cifar10_train_images_per_sec",
+        "value": round(imgs_per_sec, 1),
+        "unit": "images/sec",
+        "vs_baseline": round(imgs_per_sec / BASELINE_IMGS_PER_SEC, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

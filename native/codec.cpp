@@ -1,0 +1,74 @@
+// Native codec — the TPU build's equivalent of the reference's c-blosc
+// dependency (compression.py drives blosc.pack_array with the snappy codec;
+// tools/pre_run.sh installs python-blosc). Same role: fast lossless
+// compression of float tensors for network-crossing transfers (here: DCN
+// gradient mirrors and checkpoints — ICI allreduce stays uncompressed).
+//
+// Pipeline: optional byte-shuffle (transpose element bytes so all MSBs are
+// contiguous — floats compress far better, same trick blosc uses) + zstd.
+// Exposed as a C ABI for ctypes; no pybind11 (not in the image).
+//
+// Build: make -C native   ->  libpscodec.so
+
+#include <cstdint>
+#include <cstring>
+#include <zstd.h>
+
+extern "C" {
+
+// Transpose an array of n elements of size `typesize` so that byte k of every
+// element is contiguous (blosc-style shuffle).
+void psc_shuffle(const uint8_t* src, uint8_t* dst, size_t n, size_t typesize) {
+  if (typesize <= 1) { memcpy(dst, src, n); return; }
+  const size_t count = n / typesize;
+  for (size_t b = 0; b < typesize; ++b) {
+    const uint8_t* s = src + b;
+    uint8_t* d = dst + b * count;
+    for (size_t i = 0; i < count; ++i) d[i] = s[i * typesize];
+  }
+  // trailing bytes (n not divisible by typesize) copied verbatim
+  const size_t tail = n - count * typesize;
+  if (tail) memcpy(dst + count * typesize, src + count * typesize, tail);
+}
+
+void psc_unshuffle(const uint8_t* src, uint8_t* dst, size_t n, size_t typesize) {
+  if (typesize <= 1) { memcpy(dst, src, n); return; }
+  const size_t count = n / typesize;
+  for (size_t b = 0; b < typesize; ++b) {
+    const uint8_t* s = src + b * count;
+    uint8_t* d = dst + b;
+    for (size_t i = 0; i < count; ++i) d[i * typesize] = s[i];
+  }
+  const size_t tail = n - count * typesize;
+  if (tail) memcpy(dst + count * typesize, src + count * typesize, tail);
+}
+
+size_t psc_max_compressed_size(size_t n) { return ZSTD_compressBound(n); }
+
+// Compress n bytes (optionally shuffled with element size `typesize`).
+// Returns compressed size, or -1 on error.
+long long psc_compress(const uint8_t* src, size_t n, size_t typesize,
+                       int level, int do_shuffle, uint8_t* dst,
+                       size_t dst_cap, uint8_t* scratch) {
+  const uint8_t* payload = src;
+  if (do_shuffle && typesize > 1) {
+    psc_shuffle(src, scratch, n, typesize);
+    payload = scratch;
+  }
+  const size_t r = ZSTD_compress(dst, dst_cap, payload, n, level);
+  if (ZSTD_isError(r)) return -1;
+  return (long long)r;
+}
+
+// Decompress into dst (n_out = exact original size), then unshuffle.
+long long psc_decompress(const uint8_t* src, size_t n_src, size_t typesize,
+                         int do_shuffle, uint8_t* dst, size_t n_out,
+                         uint8_t* scratch) {
+  uint8_t* target = (do_shuffle && typesize > 1) ? scratch : dst;
+  const size_t r = ZSTD_decompress(target, n_out, src, n_src);
+  if (ZSTD_isError(r) || r != n_out) return -1;
+  if (do_shuffle && typesize > 1) psc_unshuffle(scratch, dst, n_out, typesize);
+  return (long long)r;
+}
+
+}  // extern "C"

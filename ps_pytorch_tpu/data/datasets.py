@@ -108,6 +108,10 @@ class DataLoader:
         self.local_batch = batch_size // num_hosts
         shard = len(x) // num_hosts
         self.shard_size = shard
+        if drop_last and shard < self.local_batch:
+            raise ValueError(
+                f"per-host shard ({shard} samples) smaller than local batch "
+                f"({self.local_batch}); next_batch would never yield")
         self._epoch_iter = None
         self._epoch = 0
 

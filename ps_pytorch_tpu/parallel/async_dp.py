@@ -1,0 +1,114 @@
+"""Cross-slice asynchronous / stale-gradient aggregation.
+
+Within one TPU slice, SPMD is inherently synchronous — the async capability
+of the reference (stale gradients identified by step-encoded MPI tags,
+``resnet_split.py:25-42`` ``generate_tag``: ``step*1000 + (88+layer)``; K-of-N
+backup-worker cutoff, ``sync_replicas_master_nn.py:116,179``) therefore lives
+at the DCN boundary between slices (SURVEY §2.5, §5.8).
+
+Each slice computes its in-graph psum-averaged gradient, then ships it to
+this aggregator tagged with the step it was computed at — the step token is
+explicit metadata here rather than an arithmetic encoding in an MPI tag. The
+aggregator forms the update gradient from the freshest contributions:
+
+- contributions older than ``staleness_limit`` steps are dropped (the
+  reference's timeout-kill discards identifiable stale gradients,
+  ``resnet_split.py:617-728``);
+- optional exponential down-weighting ``staleness_decay**staleness`` (a
+  softer generalization of drop/keep);
+- optional K-of-N: only the freshest ``num_aggregate`` contributions count
+  (``--num-aggregate``), matching the backup-worker cutoff across slices;
+- optional codec compression of the DCN hop (``--compress-grad``,
+  ``compression.py``): gradients are stored compressed exactly as they would
+  travel, and decompressed at aggregation time.
+"""
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+
+class StaleGradientAggregator:
+    def __init__(self, n_slices: int, staleness_limit: int = 4,
+                 staleness_decay: float = 0.0, num_aggregate: int = 0,
+                 compress: bool = False, codec_level: int = 3):
+        if n_slices < 1:
+            raise ValueError("need at least one slice")
+        if num_aggregate > n_slices:
+            raise ValueError(f"num_aggregate {num_aggregate} > n_slices {n_slices}")
+        self.n = n_slices
+        self.limit = staleness_limit
+        self.decay = staleness_decay
+        self.k = num_aggregate
+        self.compress = compress
+        self.codec_level = codec_level
+        # slice_id -> (step, leaves or compressed leaves, treedef)
+        self._pool: Dict[int, Tuple[int, List[Any], Any]] = {}
+
+    def submit(self, slice_id: int, step: int, grads: Any) -> None:
+        """Latest-wins per slice (a newer local gradient supersedes an unsent
+        older one, like the reference master's per-worker recv buffers)."""
+        if not (0 <= slice_id < self.n):
+            raise ValueError(f"slice_id {slice_id} out of range")
+        leaves, treedef = jax.tree.flatten(grads)
+        leaves = [np.asarray(l) for l in leaves]
+        if self.compress:
+            from ps_pytorch_tpu.compression import g_compress
+            leaves = [g_compress(l, level=self.codec_level) for l in leaves]
+        self._pool[slice_id] = (step, leaves, treedef)
+
+    def wire_bytes(self) -> int:
+        """Bytes currently pooled (what crossed / would cross DCN)."""
+        total = 0
+        for _, leaves, _ in self._pool.values():
+            for l in leaves:
+                total += len(l) if isinstance(l, (bytes, bytearray)) else l.nbytes
+        return total
+
+    def collect(self, current_step: int) -> Tuple[Optional[Any], dict]:
+        """-> (weighted-average gradient pytree or None, info).
+
+        info: {"used": [slice ids], "dropped_stale": [...], "weights": {...}}
+        """
+        fresh = []
+        dropped = []
+        for sid, (step, leaves, treedef) in self._pool.items():
+            staleness = current_step - step
+            if staleness < 0 or staleness > self.limit:
+                dropped.append(sid)
+                continue
+            fresh.append((staleness, sid, leaves, treedef))
+        # K freshest (stalest dropped first); ties -> lower slice id.
+        fresh.sort(key=lambda t: (t[0], t[1]))
+        if self.k > 0:
+            fresh = fresh[:self.k]
+        if not fresh:
+            return None, {"used": [], "dropped_stale": dropped, "weights": {}}
+        weights = {}
+        acc = None
+        wsum = 0.0
+        treedef_out = fresh[0][3]
+        for staleness, sid, leaves, treedef in fresh:
+            w = self.decay ** staleness if self.decay > 0 else 1.0
+            weights[sid] = w
+            if self.compress:
+                from ps_pytorch_tpu.compression import g_decompress
+                leaves = [g_decompress(l) for l in leaves]
+            if acc is None:
+                acc = [w * l.astype(np.float32) for l in leaves]
+            else:
+                for a, l in zip(acc, leaves):
+                    a += w * l.astype(np.float32)
+            wsum += w
+        avg = [a / wsum for a in acc]
+        info = {"used": [sid for _, sid, _, _ in fresh],
+                "dropped_stale": dropped, "weights": weights}
+        return jax.tree.unflatten(treedef_out, avg), info
+
+    def drop_older_than(self, current_step: int) -> None:
+        """GC the pool (contributions that can never be used again)."""
+        dead = [sid for sid, (step, _, _) in self._pool.items()
+                if current_step - step > self.limit]
+        for sid in dead:
+            del self._pool[sid]

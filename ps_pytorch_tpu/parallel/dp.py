@@ -121,6 +121,14 @@ def make_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
             lambda g: jax.lax.psum(g * m, "data") / denom, grads)
         updates, new_opt = tx.update(gavg, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
+        # An all-zero mask must be a true no-op: the reference master never
+        # steps without K gradients (sync_replicas_master_nn.py:179,204-208);
+        # without this guard momentum decay/step counters would still move.
+        stepped = msum > 0
+        new_params = jax.tree.map(
+            lambda new, old: jnp.where(stepped, new, old), new_params, state.params)
+        new_opt = jax.tree.map(
+            lambda new, old: jnp.where(stepped, new, old), new_opt, state.opt_state)
         if has_bn and sync_batchnorm:
             # Masked mean: replicas excluded by K-of-N must not contaminate
             # the synced stats (same discipline as the gradient path).

@@ -1,0 +1,6 @@
+from ps_pytorch_tpu.runtime.checkpoint import (  # noqa: F401
+    save_checkpoint, load_checkpoint, latest_step, checkpoint_path,
+)
+from ps_pytorch_tpu.runtime.coordinator import Coordinator  # noqa: F401
+from ps_pytorch_tpu.runtime.trainer import Trainer  # noqa: F401
+from ps_pytorch_tpu.runtime.evaluator import Evaluator  # noqa: F401

@@ -1,0 +1,103 @@
+"""Step-named checkpoints with atomic commit and resume.
+
+Reproduces the reference's checkpoint contract — ``train_dir/model_step_<k>``
+written every ``eval_freq`` steps for a polling evaluator
+(``sync_replicas_master_nn.py:264-270``, ``distributed_evaluator.py:74-88``) —
+and closes its biggest gap: the reference cannot resume (training always
+starts at step 1, ``sync_replicas_master_nn.py:18``); here ``load_checkpoint``
+restores params, optimizer state, replica-local BN stats, and the config.
+
+Layout: ``train_dir/model_step_<k>/`` containing ``state.msgpack`` (flax
+serialization of the TrainState pytree), ``config.json``, ``meta.json``.
+Atomic commit: write into ``train_dir/.tmp_<k>`` then ``os.rename`` — the
+evaluator can never observe a half-written checkpoint (the reference's
+torch.save to NFS has no such guarantee).
+
+Optional codec compression (``compress=True``) applies the native
+blosc-equivalent to the serialized bytes — the checkpoint/DCN leg of the
+reference's ``--compress-grad`` capability (``compression.py``).
+"""
+
+import json
+import os
+import re
+from typing import Any, Optional, Tuple
+
+import jax
+import numpy as np
+from flax import serialization
+
+_STEP_RE = re.compile(r"^model_step_(\d+)$")
+
+
+def checkpoint_path(train_dir: str, step: int) -> str:
+    return os.path.join(train_dir, f"model_step_{step}")
+
+
+def save_checkpoint(train_dir: str, step: int, state: Any,
+                    config_json: str = "{}", compress: bool = False,
+                    codec_level: int = 3, extra_meta: Optional[dict] = None) -> str:
+    """Atomically write train_dir/model_step_<step>. Returns the final path."""
+    os.makedirs(train_dir, exist_ok=True)
+    state = jax.device_get(state)
+    blob = serialization.to_bytes(state)
+    meta = {"step": step, "compressed": bool(compress), **(extra_meta or {})}
+    if compress:
+        from ps_pytorch_tpu.compression import w_compress
+        blob = w_compress(np.frombuffer(blob, np.uint8), level=codec_level)
+    tmp = os.path.join(train_dir, f".tmp_{step}")
+    final = checkpoint_path(train_dir, step)
+    if os.path.exists(tmp):
+        import shutil
+        shutil.rmtree(tmp)
+    os.makedirs(tmp)
+    with open(os.path.join(tmp, "state.msgpack"), "wb") as f:
+        f.write(blob)
+    with open(os.path.join(tmp, "config.json"), "w") as f:
+        f.write(config_json)
+    with open(os.path.join(tmp, "meta.json"), "w") as f:
+        json.dump(meta, f)
+    if os.path.exists(final):  # overwrite-last-wins, like the workers' NFS writes
+        import shutil
+        shutil.rmtree(final)
+    os.rename(tmp, final)
+    return final
+
+
+def load_checkpoint(train_dir: str, step: int, target: Any) -> Tuple[Any, dict, str]:
+    """-> (state_like_target, meta, config_json)."""
+    path = checkpoint_path(train_dir, step)
+    with open(os.path.join(path, "meta.json")) as f:
+        meta = json.load(f)
+    with open(os.path.join(path, "state.msgpack"), "rb") as f:
+        blob = f.read()
+    if meta.get("compressed"):
+        from ps_pytorch_tpu.compression import w_decompress
+        blob = w_decompress(blob).tobytes()
+    with open(os.path.join(path, "config.json")) as f:
+        config_json = f.read()
+    state = serialization.from_bytes(target, blob)
+    return state, meta, config_json
+
+
+def latest_step(train_dir: str) -> Optional[int]:
+    """Largest k with a committed model_step_<k>, or None."""
+    if not os.path.isdir(train_dir):
+        return None
+    steps = [int(m.group(1)) for name in os.listdir(train_dir)
+             if (m := _STEP_RE.match(name))]
+    return max(steps) if steps else None
+
+
+def wait_for_step(train_dir: str, step: int, poll_s: float = 10.0,
+                  timeout_s: Optional[float] = None) -> bool:
+    """Block until model_step_<step> exists (the evaluator's poll loop,
+    ``distributed_evaluator.py:79-88`` — 10 s poll interval parity)."""
+    import time
+    waited = 0.0
+    while not os.path.isdir(checkpoint_path(train_dir, step)):
+        if timeout_s is not None and waited >= timeout_s:
+            return False
+        time.sleep(poll_s)
+        waited += poll_s
+    return True

@@ -1,0 +1,159 @@
+"""Coordinator — the control plane.
+
+The semantic home of the reference's small-message wire protocol (SURVEY
+§2.3): step announcement (tag 10, ``sync_replicas_master_nn.py:210-216``),
+straggler kill (tag 77, ``resnet_split.py:511-523``), and the backup-worker
+K-of-N cutoff (``--num-aggregate``, ``sync_replicas_master_nn.py:116,179``).
+
+On TPU the data plane needs none of this — gradients are psum'd in-graph —
+so what remains of the "master" is exactly this object: step control,
+per-step participation policy, deadline enforcement, and checkpoint
+authority. It runs on every host against a shared key-value store:
+in-process dict on one host, the JAX coordination-service KV across hosts
+(the jax.distributed client), replacing MPI point-to-point control messages
+with DCN KV ops.
+
+Policies (all host-side; the device step stays fixed-shape and just
+consumes the mask vector):
+
+- sync: everyone participates every step.
+- kofn: only the K replicas with the fastest last-observed step time
+  contribute (the reference master aggregates the first ``num_aggregate``
+  gradient arrivals per layer and discards the rest, ``:179``).
+- deadline: replicas whose last step exceeded ``kill_threshold`` seconds are
+  masked out — the deadline-based re-expression of the tag-77 kill protocol
+  (the reference worker aborts its backward mid-flight; here its
+  contribution is simply excluded while the SPMD step completes).
+"""
+
+import json
+import threading
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+
+class KVStore:
+    """Minimal KV interface. In-process default; DistributedKV over the JAX
+    coordination service for multi-host (replaces MPI tags over DCN)."""
+
+    def __init__(self):
+        self._d: Dict[str, str] = {}
+        self._lock = threading.Lock()
+
+    def set(self, key: str, value: str) -> None:
+        with self._lock:
+            self._d[key] = value
+
+    def get(self, key: str, default: Optional[str] = None) -> Optional[str]:
+        with self._lock:
+            return self._d.get(key, default)
+
+
+class DistributedKV(KVStore):
+    """KV over the JAX coordination service (available after
+    ``jax.distributed.initialize``); keys are visible to every host."""
+
+    def __init__(self):
+        super().__init__()
+        from jax._src import distributed
+        client = distributed.global_state.client
+        if client is None:
+            raise RuntimeError("jax.distributed not initialized")
+        self._client = client
+
+    def set(self, key: str, value: str) -> None:
+        self._client.key_value_set(key, value)
+
+    def get(self, key: str, default: Optional[str] = None) -> Optional[str]:
+        try:
+            return self._client.blocking_key_value_get(key, 1000)
+        except Exception:
+            return default
+
+
+class Coordinator:
+    def __init__(self, n_replicas: int, mode: str = "sync",
+                 num_aggregate: int = 0, kill_threshold: float = 0.0,
+                 kv: Optional[KVStore] = None, run_id: str = "run"):
+        if mode not in ("sync", "kofn", "async"):
+            raise ValueError(f"unknown mode {mode!r}")
+        if mode == "kofn" and not (0 < num_aggregate <= n_replicas):
+            raise ValueError(
+                f"kofn requires 0 < num_aggregate <= {n_replicas}, got {num_aggregate}")
+        self.n = n_replicas
+        self.mode = mode
+        self.k = num_aggregate
+        self.kill_threshold = kill_threshold
+        self.kv = kv or KVStore()
+        self.run_id = run_id
+        # last observed per-replica step duration (telemetry; seconds)
+        self._last_duration = np.zeros(n_replicas, np.float64)
+        self._killed = np.zeros(n_replicas, bool)
+
+    # ---- step control (tag 10 equivalent) ----
+    def announce_step(self, step: int) -> None:
+        self.kv.set(f"{self.run_id}/step", str(step))
+
+    def current_step(self) -> int:
+        return int(self.kv.get(f"{self.run_id}/step", "0"))
+
+    def wait_for_step(self, after: int, timeout_s: float = 300.0,
+                      poll_s: float = 0.01) -> int:
+        """Worker-side: spin until the announced step advances past ``after``
+        (the reference worker's step-sync spin, ``distributed_worker.py:129-143``)."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            cur = self.current_step()
+            if cur > after:
+                return cur
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"step did not advance past {after}")
+            time.sleep(poll_s)
+
+    # ---- telemetry ----
+    def report_duration(self, replica: int, step: int, seconds: float) -> None:
+        self._last_duration[replica] = seconds
+        self.kv.set(f"{self.run_id}/dur/{replica}", json.dumps([step, seconds]))
+
+    def pull_durations(self) -> np.ndarray:
+        for r in range(self.n):
+            v = self.kv.get(f"{self.run_id}/dur/{r}")
+            if v is not None:
+                _, s = json.loads(v)
+                self._last_duration[r] = s
+        return self._last_duration
+
+    # ---- participation policy (num_aggregate / tag 77 equivalents) ----
+    def participation_mask(self, step: int) -> np.ndarray:
+        """float32[n] mask for the next step's in-graph masked psum."""
+        mask = (~self._killed).astype(np.float32)
+        if self.mode == "sync":
+            return mask
+        dur = self.pull_durations()
+        if self.kill_threshold > 0:
+            mask *= (dur <= self.kill_threshold).astype(np.float32)
+        if self.mode == "kofn" and self.k < self.n:
+            # Fastest-K by last observed duration ~ "first K gradient
+            # arrivals" (sync_replicas_master_nn.py:179). Ties -> lower index.
+            alive = np.nonzero(mask > 0)[0]
+            if len(alive) > self.k:
+                keep = alive[np.argsort(dur[alive], kind="stable")[:self.k]]
+                mask = np.zeros(self.n, np.float32)
+                mask[keep] = 1.0
+        if mask.sum() == 0:
+            # Never let the run wedge: fall back to everyone (the reference
+            # master always waits for all arrivals eventually, :184-186).
+            mask = (~self._killed).astype(np.float32)
+            if mask.sum() == 0:
+                mask = np.ones(self.n, np.float32)
+        return mask
+
+    # ---- kill protocol (tag 77 equivalent) ----
+    def kill(self, replica: int) -> None:
+        self._killed[replica] = True
+        self.kv.set(f"{self.run_id}/kill/{replica}", "1")
+
+    def is_killed(self, replica: int) -> bool:
+        return self.kv.get(f"{self.run_id}/kill/{replica}") == "1"

@@ -1,0 +1,104 @@
+"""Standalone polling evaluator.
+
+Reproduces the reference's evaluator contract
+(``distributed_evaluator.py:74-114``): a separate process watches the
+checkpoint directory for ``model_step_<k>``, loads each new checkpoint, and
+reports loss / Prec@1 / Prec@5 on the test set. Differences: atomic
+checkpoints mean no torn reads; the model/config are read from the checkpoint
+itself (no flag duplication); and the reference's latent crash at
+``distributed_evaluator.py:145`` (undefined ``worker_fc_nn``) has no
+equivalent here.
+"""
+
+import time
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ps_pytorch_tpu.config import TrainConfig
+from ps_pytorch_tpu.data import prepare_data
+from ps_pytorch_tpu.models import build_model
+from ps_pytorch_tpu.optim import build_optimizer
+from ps_pytorch_tpu.parallel import create_train_state, make_eval_step, make_mesh
+from ps_pytorch_tpu.parallel.dp import replica0_batch_stats
+from ps_pytorch_tpu.runtime import checkpoint as ckpt
+
+EVAL_LINE = "EVAL step {step} loss {loss:.6f} prec1 {prec1:.4f} prec5 {prec5:.4f}"
+
+
+class Evaluator:
+    def __init__(self, train_dir: str, poll_s: float = 10.0,
+                 printer: Callable = print, download: bool = False):
+        self.train_dir = train_dir
+        self.poll_s = poll_s
+        self.printer = printer
+        self.download = download
+        self._built_for: Optional[str] = None
+
+    def _build(self, config_json: str):
+        cfg = TrainConfig.from_json(config_json)
+        self.cfg = cfg
+        self.model = build_model(cfg.network, cfg.num_classes, cfg.compute_dtype)
+        # Template state for deserialization; single-device mesh is fine here.
+        mesh = make_mesh(data=1)
+        self.template = create_train_state(
+            self.model, build_optimizer(cfg), mesh,
+            (1,) + {"MNIST": (28, 28, 1), "synthetic_mnist": (28, 28, 1)}.get(
+                cfg.dataset, (32, 32, 3)), jax.random.key(0))
+        _, self.test_loader = prepare_data(cfg, download=self.download)
+        self.eval_fn = make_eval_step(self.model)
+        self._built_for = config_json
+
+    def evaluate_step(self, step: int) -> dict:
+        path = ckpt.checkpoint_path(self.train_dir, step)
+        with open(f"{path}/config.json") as f:
+            config_json = f.read()
+        if config_json != self._built_for:
+            self._build(config_json)
+        state, meta, _ = ckpt.load_checkpoint(self.train_dir, step, self.template)
+        params = state.params
+        bstats = replica0_batch_stats(state)
+        tot = {"sum_loss": 0.0, "top1": 0, "top5": 0, "count": 0}
+        for x, y in self.test_loader.epoch(0):
+            m = self.eval_fn(params, bstats, jnp.asarray(x), jnp.asarray(y))
+            tot["sum_loss"] += float(m["sum_loss"])
+            for k in ("top1", "top5", "count"):
+                tot[k] += int(m[k])
+        n = max(tot["count"], 1)
+        result = {"step": step, "loss": tot["sum_loss"] / n,
+                  "prec1": tot["top1"] / n, "prec5": tot["top5"] / n}
+        self.printer(EVAL_LINE.format(**result))
+        return result
+
+    def run(self, stop_after: Optional[int] = None,
+            idle_timeout_s: Optional[float] = None) -> list:
+        """Poll-evaluate loop (reference ``:79-88``): wake every poll_s,
+        evaluate any checkpoint newer than the last one seen."""
+        done = -1
+        results = []
+        idle = 0.0
+        while True:
+            latest = ckpt.latest_step(self.train_dir)
+            if latest is not None and latest > done:
+                # Evaluate every committed step between done and latest.
+                steps = sorted(s for s in self._all_steps() if s > done)
+                for s in steps:
+                    results.append(self.evaluate_step(s))
+                done = latest
+                idle = 0.0
+                if stop_after is not None and done >= stop_after:
+                    return results
+            else:
+                time.sleep(self.poll_s)
+                idle += self.poll_s
+                if idle_timeout_s is not None and idle >= idle_timeout_s:
+                    return results
+
+    def _all_steps(self):
+        import os, re
+        pat = re.compile(r"^model_step_(\d+)$")
+        if not os.path.isdir(self.train_dir):
+            return []
+        return [int(m.group(1)) for n in os.listdir(self.train_dir)
+                if (m := pat.match(n))]

@@ -1,0 +1,70 @@
+"""Structured per-step metrics.
+
+The reference's observability is hand-rolled wall-clock prints whose exact
+format downstream tooling regex-parses (``distributed_worker.py:169-173``,
+``tiny_tuning_parser.py:18-20``, SURVEY §5.1). Here the schema is defined
+once: every step emits (a) one stable human-readable line and (b) optionally
+one JSON line to a metrics file. ``parse_line`` is the inverse, used by the
+analysis tooling (tools/analyze.py) and by the log-schema test — the schema
+cannot drift without a test failing.
+"""
+
+import json
+import re
+import time
+from typing import IO, Optional
+
+# Stable human schema. Field order is part of the contract.
+_LINE = ("STEP {step} epoch {epoch} loss {loss:.6f} acc {acc:.4f} "
+         "participating {participating:g} step_time {step_time:.4f} "
+         "data_time {data_time:.4f}")
+_LINE_RE = re.compile(
+    r"STEP (?P<step>\d+) epoch (?P<epoch>\d+) loss (?P<loss>[-\d.naninf]+) "
+    r"acc (?P<acc>[-\d.naninf]+) participating (?P<participating>[-\d.]+) "
+    r"step_time (?P<step_time>[\d.]+) data_time (?P<data_time>[\d.]+)")
+
+
+def format_line(step: int, epoch: int, loss: float, acc: float,
+                participating: float, step_time: float, data_time: float) -> str:
+    return _LINE.format(step=step, epoch=epoch, loss=loss, acc=acc,
+                        participating=participating, step_time=step_time,
+                        data_time=data_time)
+
+
+def parse_line(line: str) -> Optional[dict]:
+    m = _LINE_RE.search(line)
+    if not m:
+        return None
+    d = m.groupdict()
+    return {"step": int(d["step"]), "epoch": int(d["epoch"]),
+            "loss": float(d["loss"]), "acc": float(d["acc"]),
+            "participating": float(d["participating"]),
+            "step_time": float(d["step_time"]), "data_time": float(d["data_time"])}
+
+
+class MetricsLogger:
+    """Per-step sink: stdout human line + optional JSONL file."""
+
+    def __init__(self, jsonl_path: str = "", log_every: int = 1,
+                 printer=print):
+        self.log_every = max(log_every, 1)
+        self.printer = printer
+        self._fh: Optional[IO] = open(jsonl_path, "a") if jsonl_path else None
+
+    def log_step(self, step: int, epoch: int, *, loss: float, acc: float,
+                 participating: float, step_time: float, data_time: float,
+                 **extra) -> None:
+        if step % self.log_every == 0:
+            self.printer(format_line(step, epoch, loss, acc, participating,
+                                     step_time, data_time))
+        if self._fh is not None:
+            rec = {"ts": time.time(), "step": step, "epoch": epoch,
+                   "loss": loss, "acc": acc, "participating": participating,
+                   "step_time": step_time, "data_time": data_time, **extra}
+            self._fh.write(json.dumps(rec) + "\n")
+            self._fh.flush()
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None

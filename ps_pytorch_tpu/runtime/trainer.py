@@ -1,0 +1,141 @@
+"""The training driver — role-merged replacement for the reference's
+master/worker pair.
+
+One Trainer per host drives the jitted SPMD step; there is no separate
+parameter-server process. What the reference split across
+``SyncReplicasMaster_NN.start()`` (``sync_replicas_master_nn.py:133-197``) and
+``DistributedWorker.train()`` (``distributed_worker.py:104-180``) — step
+announce, weight broadcast, gradient ship, aggregate, update, checkpoint,
+per-phase timing logs — collapses here into: next batch -> step_fn (forward,
+backward, masked psum, update, all on-device) -> telemetry -> occasional
+checkpoint. The Coordinator supplies the per-step participation mask
+(backup-worker/deadline policies) and step control.
+"""
+
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ps_pytorch_tpu.config import TrainConfig
+from ps_pytorch_tpu.data import prepare_data
+from ps_pytorch_tpu.models import build_model
+from ps_pytorch_tpu.optim import build_optimizer
+from ps_pytorch_tpu.parallel import (
+    create_train_state, make_eval_step, make_train_step, make_mesh,
+)
+from ps_pytorch_tpu.parallel.dp import replica0_batch_stats
+from ps_pytorch_tpu.parallel.mesh import local_data_shard
+from ps_pytorch_tpu.runtime import checkpoint as ckpt
+from ps_pytorch_tpu.runtime.coordinator import Coordinator
+from ps_pytorch_tpu.runtime.metrics import MetricsLogger
+
+_SAMPLE_SHAPES = {  # dataset -> single-example input shape
+    "MNIST": (28, 28, 1), "synthetic_mnist": (28, 28, 1),
+    "Cifar10": (32, 32, 3), "Cifar100": (32, 32, 3), "SVHN": (32, 32, 3),
+    "synthetic": (32, 32, 3),
+}
+
+
+class Trainer:
+    def __init__(self, cfg: TrainConfig, mesh=None, coordinator: Optional[Coordinator] = None,
+                 download: bool = False):
+        self.cfg = cfg
+        self.mesh = mesh if mesh is not None else make_mesh(data=cfg.data_axis,
+                                                            model=cfg.model_axis)
+        self.n_data = self.mesh.shape["data"]
+        self.model = build_model(cfg.network, cfg.num_classes, cfg.compute_dtype)
+        self.tx = build_optimizer(cfg)
+        host_id, num_hosts = local_data_shard()
+        self.train_loader, self.test_loader = prepare_data(
+            cfg, host_id=host_id, num_hosts=num_hosts, download=download)
+        sample = (1,) + _SAMPLE_SHAPES[cfg.dataset]
+        self.state = create_train_state(self.model, self.tx, self.mesh, sample,
+                                        jax.random.key(cfg.seed))
+        self.step_fn = make_train_step(self.model, self.tx, self.mesh, self.state,
+                                       sync_batchnorm=cfg.sync_batchnorm,
+                                       remat=cfg.remat, donate=cfg.donate)
+        self.eval_fn = make_eval_step(self.model)
+        self.coordinator = coordinator or Coordinator(
+            self.n_data, mode=cfg.mode, num_aggregate=cfg.num_aggregate,
+            kill_threshold=cfg.kill_threshold)
+        self.metrics = MetricsLogger(cfg.metrics_file, cfg.log_every)
+        self.start_step = 0
+        if cfg.resume:
+            self._maybe_resume()
+
+    def _maybe_resume(self) -> None:
+        """NEW vs the reference (which always restarts at step 1,
+        ``sync_replicas_master_nn.py:18``): restore-to-train."""
+        step = ckpt.latest_step(self.cfg.train_dir)
+        if step is None:
+            return
+        state, meta, _ = ckpt.load_checkpoint(self.cfg.train_dir, step, self.state)
+        from ps_pytorch_tpu.parallel.dp import state_shardings
+        self.state = jax.device_put(state, state_shardings(self.mesh, state))
+        self.start_step = int(meta["step"])
+        print(f"RESUME from {ckpt.checkpoint_path(self.cfg.train_dir, step)} "
+              f"at step {self.start_step}")
+
+    def _checkpoint(self, step: int) -> None:
+        ckpt.save_checkpoint(self.cfg.train_dir, step, self.state,
+                             config_json=self.cfg.to_json(),
+                             compress=self.cfg.compress_grad,
+                             codec_level=self.cfg.codec_level)
+
+    def train(self):
+        """Run to max_steps (or epochs * steps-per-epoch, whichever is
+        smaller — reference semantics: both bounds live on the CLI,
+        ``distributed_nn.py:34-36``)."""
+        cfg = self.cfg
+        steps_per_epoch = max(len(self.train_loader), 1)
+        epoch_budget = cfg.epochs * steps_per_epoch if cfg.epochs > 0 else cfg.max_steps
+        last_step = min(cfg.max_steps, epoch_budget)
+        step = self.start_step
+        while step < last_step:
+            step += 1
+            self.coordinator.announce_step(step)
+            t0 = time.monotonic()
+            x, y = self.train_loader.next_batch()
+            t_data = time.monotonic() - t0
+            mask = self.coordinator.participation_mask(step)
+            new_state, m = self.step_fn(
+                self.state, jnp.asarray(x), jnp.asarray(y),
+                jnp.asarray(mask), jax.random.key(cfg.seed * 100003 + step))
+            self.state = new_state
+            if step % cfg.log_every == 0 or step == last_step:
+                # Materializing metrics syncs the device; skip between logs.
+                loss = float(m["loss"])
+                acc = float(m["accuracy"])
+                part = float(m["participating"])
+                t_step = time.monotonic() - t0
+                epoch = (step - 1) // steps_per_epoch
+                self.metrics.log_step(step, epoch, loss=loss, acc=acc,
+                                      participating=part, step_time=t_step,
+                                      data_time=t_data)
+                self.coordinator.report_duration(0, step, t_step)
+            if cfg.eval_freq > 0 and step % cfg.eval_freq == 0:
+                self._checkpoint(step)
+        jax.block_until_ready(self.state.params)
+        if cfg.eval_freq > 0 and step % cfg.eval_freq != 0:
+            self._checkpoint(step)
+        self.metrics.close()
+        return self.state
+
+    def evaluate(self, max_batches: Optional[int] = None) -> dict:
+        """Top-1/top-5/loss over the test loader (reference
+        ``_evaluate_model``, ``distributed_evaluator.py:90-106``)."""
+        params = self.state.params
+        bstats = replica0_batch_stats(self.state)
+        tot = {"sum_loss": 0.0, "top1": 0, "top5": 0, "count": 0}
+        for i, (x, y) in enumerate(self.test_loader.epoch(0)):
+            if max_batches is not None and i >= max_batches:
+                break
+            m = self.eval_fn(params, bstats, jnp.asarray(x), jnp.asarray(y))
+            for k in tot:
+                tot[k] += float(m[k]) if k == "sum_loss" else int(m[k])
+        n = max(tot["count"], 1)
+        return {"loss": tot["sum_loss"] / n, "prec1": tot["top1"] / n,
+                "prec5": tot["top5"] / n, "count": tot["count"]}

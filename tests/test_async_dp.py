@@ -1,0 +1,90 @@
+"""Stale-gradient cross-slice aggregation tests (reference async semantics:
+staleness step-tokens resnet_split.py:25-42, K-of-N cutoff
+sync_replicas_master_nn.py:179, --compress-grad)."""
+
+import numpy as np
+import pytest
+
+from ps_pytorch_tpu.parallel.async_dp import StaleGradientAggregator
+
+
+def _g(v):
+    return {"w": np.full((4,), v, np.float32), "b": np.full((2,), -v, np.float32)}
+
+
+def test_average_fresh():
+    agg = StaleGradientAggregator(3)
+    for s in range(3):
+        agg.submit(s, step=10, grads=_g(float(s)))
+    avg, info = agg.collect(10)
+    np.testing.assert_allclose(avg["w"], np.full(4, 1.0))
+    assert sorted(info["used"]) == [0, 1, 2]
+
+
+def test_staleness_drop():
+    agg = StaleGradientAggregator(3, staleness_limit=2)
+    agg.submit(0, step=10, grads=_g(1.0))
+    agg.submit(1, step=7, grads=_g(100.0))   # staleness 3 > 2 -> dropped
+    agg.submit(2, step=9, grads=_g(3.0))
+    avg, info = agg.collect(10)
+    np.testing.assert_allclose(avg["w"], np.full(4, 2.0))
+    assert info["dropped_stale"] == [1]
+
+
+def test_staleness_decay_weighting():
+    agg = StaleGradientAggregator(2, staleness_limit=4, staleness_decay=0.5)
+    agg.submit(0, step=10, grads=_g(0.0))    # weight 1
+    agg.submit(1, step=8, grads=_g(4.0))     # weight 0.25
+    avg, info = agg.collect(10)
+    np.testing.assert_allclose(avg["w"], np.full(4, 0.8))  # (0*1+4*.25)/1.25
+    assert info["weights"][1] == 0.25
+
+
+def test_kofn_freshest():
+    agg = StaleGradientAggregator(4, staleness_limit=8, num_aggregate=2)
+    agg.submit(0, step=6, grads=_g(9.0))
+    agg.submit(1, step=10, grads=_g(1.0))
+    agg.submit(2, step=9, grads=_g(3.0))
+    agg.submit(3, step=5, grads=_g(9.0))
+    avg, info = agg.collect(10)
+    np.testing.assert_allclose(avg["w"], np.full(4, 2.0))  # slices 1,2 only
+    assert sorted(info["used"]) == [1, 2]
+
+
+def test_compressed_wire_path():
+    agg = StaleGradientAggregator(2, compress=True)
+    g = {"w": np.linspace(0, 1, 4096, dtype=np.float32)}
+    agg.submit(0, step=1, grads=g)
+    agg.submit(1, step=1, grads=g)
+    assert agg.wire_bytes() < 2 * g["w"].nbytes  # compressed on the wire
+    avg, _ = agg.collect(1)
+    np.testing.assert_allclose(avg["w"], g["w"], rtol=1e-6)
+
+
+def test_empty_and_future_contributions():
+    agg = StaleGradientAggregator(2, staleness_limit=1)
+    avg, info = agg.collect(5)
+    assert avg is None and info["used"] == []
+    agg.submit(0, step=9, grads=_g(1.0))  # "future" vs current_step=5
+    avg, info = agg.collect(5)
+    assert avg is None and info["dropped_stale"] == [0]
+
+
+def test_latest_wins_and_gc():
+    agg = StaleGradientAggregator(1, staleness_limit=0)
+    agg.submit(0, step=1, grads=_g(1.0))
+    agg.submit(0, step=2, grads=_g(2.0))
+    avg, _ = agg.collect(2)
+    np.testing.assert_allclose(avg["w"], np.full(4, 2.0))
+    agg.drop_older_than(5)
+    assert agg.collect(5)[0] is None
+
+
+def test_validates():
+    with pytest.raises(ValueError):
+        StaleGradientAggregator(0)
+    with pytest.raises(ValueError):
+        StaleGradientAggregator(2, num_aggregate=3)
+    agg = StaleGradientAggregator(2)
+    with pytest.raises(ValueError):
+        agg.submit(5, step=1, grads=_g(1.0))

@@ -58,3 +58,11 @@ def test_next_batch_advances_epochs():
     for _ in range(5):  # crosses epoch boundaries without StopIteration
         xb, yb = train.next_batch()
         assert xb.shape[0] == 25000
+
+
+def test_shard_smaller_than_batch_rejected():
+    import pytest
+    x = np.zeros((100, 4, 4, 1), np.float32)
+    y = np.zeros(100, np.int32)
+    with pytest.raises(ValueError):
+        DataLoader(x, y, batch_size=2048, num_hosts=8)

@@ -125,3 +125,21 @@ def test_eval_step(mesh8):
                 jnp.asarray(x), jnp.asarray(y))
     assert int(m["count"]) == 32
     assert 0 <= int(m["top1"]) <= int(m["top5"]) <= 32
+
+
+def test_all_masked_step_is_noop(mesh8):
+    """mask == zeros must leave params AND optimizer state untouched."""
+    model, tx, state, step_fn = _setup(mesh8)
+    rng = np.random.default_rng(6)
+    x = rng.normal(size=(16, 28, 28, 1)).astype(np.float32)
+    y = rng.integers(0, 10, 16).astype(np.int32)
+    # One real step first so momentum buffers are non-zero.
+    state, _ = step_fn(state, jnp.asarray(x), jnp.asarray(y),
+                       jnp.ones(8, jnp.float32), jax.random.key(0))
+    new_state, m = step_fn(state, jnp.asarray(x), jnp.asarray(y),
+                           jnp.zeros(8, jnp.float32), jax.random.key(1))
+    assert float(m["participating"]) == 0.0
+    for a, b in zip(jax.tree.leaves(new_state.params), jax.tree.leaves(state.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(jax.tree.leaves(new_state.opt_state), jax.tree.leaves(state.opt_state)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -1,0 +1,37 @@
+#!/usr/bin/env python
+"""Training entry point — the TPU-native replacement for the reference's
+``distributed_nn.py`` + ``run_pytorch.sh`` (and, with a 1-device mesh,
+``single_machine.py``: in SPMD the single-machine baseline is just the
+degenerate mesh, no separate code path).
+
+No mpirun: on a TPU pod slice, launch this same script on every host
+(``python -m ps_pytorch_tpu.tools.launch`` or your pod runner); JAX's
+distributed runtime wires the hosts together, the mesh spans all chips, and
+each host feeds its own data shard.
+
+Example:
+    python train.py --network LeNet --dataset MNIST --batch-size 512 \
+        --lr 0.01 --momentum 0.9 --max-steps 1000 --eval-freq 100
+"""
+
+import sys
+
+
+def main(argv=None) -> int:
+    from ps_pytorch_tpu.config import config_from_args
+    from ps_pytorch_tpu.runtime import Trainer
+
+    cfg = config_from_args(argv)
+    print(f"CONFIG {cfg.to_json()}")
+    trainer = Trainer(cfg)
+    print(f"MESH data={trainer.mesh.shape['data']} model={trainer.mesh.shape['model']} "
+          f"devices={len(trainer.mesh.devices.flat)}")
+    trainer.train()
+    result = trainer.evaluate()
+    print(f"FINAL loss {result['loss']:.6f} prec1 {result['prec1']:.4f} "
+          f"prec5 {result['prec5']:.4f}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
