@@ -22,6 +22,8 @@ def main(argv=None) -> int:
                    help="exit after this long with no new checkpoints")
     args = p.parse_args(argv)
 
+    from ps_pytorch_tpu.parallel import dist
+    dist.initialize_from_env()  # platform override / multi-host env contract
     from ps_pytorch_tpu.runtime import Evaluator
 
     ev = Evaluator(args.train_dir, poll_s=args.poll_s)

@@ -62,6 +62,7 @@ class TrainConfig:
 
     # -- numerics / TPU --
     compute_dtype: str = "bfloat16"  # MXU-native compute dtype; params stay float32
+    fused_optimizer: bool = False    # Pallas single-pass SGD update (ops/fused_sgd.py)
     donate: bool = True              # donate buffers to the jitted step
     remat: bool = False              # jax.checkpoint the forward for memory
 

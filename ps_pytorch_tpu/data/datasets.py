@@ -34,7 +34,17 @@ DATASET_SHAPES = {
     "SVHN": (32, 32, 3, 10, 73257),
     "synthetic": (32, 32, 3, 10, 50000),
     "synthetic_mnist": (28, 28, 1, 10, 60000),
+    # ImageNet-shaped synthetic set for the ResNet-50 at-scale config
+    # (BASELINE.json config 5); small N — it exists to exercise 224px
+    # shapes/throughput, not to be learned.
+    "synthetic_imagenet": (224, 224, 3, 1000, 512),
 }
+
+
+def sample_shape(dataset: str) -> Tuple[int, int, int]:
+    """(H, W, C) of one example — the model-init template shape."""
+    h, w, c, _, _ = DATASET_SHAPES[dataset]
+    return (h, w, c)
 
 
 def _load_torchvision(name: str, root: str, train: bool, download: bool):
@@ -64,7 +74,10 @@ def _load_torchvision(name: str, root: str, train: bool, download: bool):
 
 def _synthetic(name: str, train: bool, seed: int = 0):
     h, w, c, ncls, n = DATASET_SHAPES[name]
-    n = n if train else max(n // 6, 1000)
+    if not train:
+        # Test split ~1/6 of train with a floor, but never bigger than the
+        # train hint (keeps large-image synthetic sets memory-bounded).
+        n = max(n // 6, min(1000, n))
     rng = np.random.default_rng(seed + (0 if train else 1))
     # Class-dependent means make the task learnable -> convergence tests work.
     y = rng.integers(0, ncls, size=n).astype(np.int32)

@@ -11,6 +11,7 @@ import jax.numpy as jnp
 from ps_pytorch_tpu.models.lenet import LeNet
 from ps_pytorch_tpu.models.resnet import (
     ResNet18, ResNet34, ResNet50, ResNet101, ResNet152,
+    ResNet18_ImageNet, ResNet50_ImageNet,
 )
 from ps_pytorch_tpu.models.vgg import (
     VGG11, VGG13, VGG16, VGG19, VGG11_BN, VGG13_BN, VGG16_BN, VGG19_BN,
@@ -32,6 +33,8 @@ _REGISTRY = {
     "VGG13": VGG13_BN,
     "VGG16": VGG16_BN,
     "VGG19": VGG19_BN,
+    "ResNet18_ImageNet": ResNet18_ImageNet,
+    "ResNet50_ImageNet": ResNet50_ImageNet,
     "VGG11_plain": VGG11,
     "VGG13_plain": VGG13,
     "VGG16_plain": VGG16,

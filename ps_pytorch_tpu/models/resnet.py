@@ -72,21 +72,34 @@ class ResNet(nn.Module):
     num_blocks: Sequence[int]
     num_classes: int = 10
     dtype: Any = jnp.float32
+    imagenet_stem: bool = False  # 7x7/s2 conv + 3x3/s2 maxpool (torchvision
+    # semantics) for 224px inputs — the ResNet-50/ImageNet config is NEW vs
+    # the reference (BASELINE.json config 5); the CIFAR stem is the
+    # reference's (``model_ops/resnet.py:69-71``).
 
     @nn.compact
     def __call__(self, x, train: bool = True):
-        # x: [B, 32, 32, 3] NHWC
+        # x: [B, H, W, 3] NHWC (32px CIFAR or 224px ImageNet)
         x = x.astype(self.dtype)
-        x = Conv(64, (3, 3), padding=1, dtype=self.dtype, name="conv1")(x)
+        if self.imagenet_stem:
+            x = Conv(64, (7, 7), strides=(2, 2), padding=3, dtype=self.dtype,
+                     name="conv1")(x)
+        else:
+            x = Conv(64, (3, 3), padding=1, dtype=self.dtype, name="conv1")(x)
         x = nn.relu(nn.BatchNorm(use_running_average=not train, momentum=0.9,
                                  epsilon=1e-5, dtype=self.dtype, name="bn1")(x))
+        if self.imagenet_stem:
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
         for stage, (planes, n, stride) in enumerate(
                 zip((64, 128, 256, 512), self.num_blocks, (1, 2, 2, 2))):
             for i in range(n):
                 x = self.block(planes, stride if i == 0 else 1,
                                dtype=self.dtype)(x, train=train)
-        x = nn.avg_pool(x, (4, 4), strides=(4, 4))
-        x = x.reshape((x.shape[0], -1))
+        if self.imagenet_stem:
+            x = x.mean(axis=(1, 2))          # global average pool (7x7 -> 1)
+        else:
+            x = nn.avg_pool(x, (4, 4), strides=(4, 4))  # reference :95
+            x = x.reshape((x.shape[0], -1))
         x = nn.Dense(self.num_classes, dtype=self.dtype, name="linear")(x)
         return x.astype(jnp.float32)
 
@@ -105,3 +118,9 @@ def ResNet101(num_classes=10, dtype=jnp.float32):
 
 def ResNet152(num_classes=10, dtype=jnp.float32):
     return ResNet(Bottleneck, (3, 8, 36, 3), num_classes, dtype)
+
+def ResNet18_ImageNet(num_classes=1000, dtype=jnp.float32):
+    return ResNet(BasicBlock, (2, 2, 2, 2), num_classes, dtype, imagenet_stem=True)
+
+def ResNet50_ImageNet(num_classes=1000, dtype=jnp.float32):
+    return ResNet(Bottleneck, (3, 4, 6, 3), num_classes, dtype, imagenet_stem=True)

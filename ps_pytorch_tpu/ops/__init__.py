@@ -1,0 +1,18 @@
+"""Pallas TPU kernels for the framework's hot ops.
+
+Every kernel here has a jax/XLA-equivalent fallback and runs in Pallas
+interpreter mode off-TPU, so the test suite exercises kernel semantics on the
+CPU mesh while real runs compile to Mosaic.
+
+- ``quantize``: on-device int8 block quantization (stochastic rounding) — the
+  TPU-native leg of the reference's gradient-compression capability
+  (``compression.py``): gradients are shrunk on-chip before a DCN hop instead
+  of Blosc-packed on the host.
+- ``fused_sgd``: single-pass fused momentum-SGD parameter update (one HBM
+  read+write per buffer instead of XLA's multi-kernel chain).
+"""
+
+from ps_pytorch_tpu.ops.quantize import (  # noqa: F401
+    dequantize_int8, quantize_int8, quantized_nbytes,
+)
+from ps_pytorch_tpu.ops.fused_sgd import fused_sgd_step  # noqa: F401

@@ -16,6 +16,10 @@ def build_optimizer(cfg):
     """Config -> GradientTransformation (reference: master build_model wires
     SGD at ``sync_replicas_master_nn.py:124-131``)."""
     if cfg.optimizer == "sgd":
+        if getattr(cfg, "fused_optimizer", False):
+            from ps_pytorch_tpu.ops.fused_sgd import FusedSGD
+            return FusedSGD(lr=cfg.lr, momentum=cfg.momentum,
+                            weight_decay=cfg.weight_decay, nesterov=cfg.nesterov)
         return sgd(lr=cfg.lr, momentum=cfg.momentum,
                    weight_decay=cfg.weight_decay, nesterov=cfg.nesterov)
     if cfg.optimizer == "adam":

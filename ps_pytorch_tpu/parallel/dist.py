@@ -1,0 +1,106 @@
+"""Multi-host wiring — the DCN control/bootstrap layer.
+
+The reference bootstraps its world with ``mpirun --hostfile hosts_address``
+(``run_pytorch.sh:1-16``) and OpenMPI's out-of-band TCP wire-up; every
+subsequent cross-host byte rides hand-rolled MPI tags (SURVEY §2.3). Here
+bootstrap is ``jax.distributed.initialize`` (gRPC coordination service over
+DCN): the launcher (`ps_pytorch_tpu.tools.launch`) exports three environment
+variables per host and each process calls :func:`initialize_from_env` before
+touching any device. After that the data plane is pure XLA collectives over
+the global mesh; the coordination-service KV doubles as the Coordinator's
+control plane (runtime/coordinator.py DistributedKV).
+
+Also home to the host-local -> global array assembly helpers: with more than
+one process, a jitted function over a global mesh consumes *global* jax.Arrays
+whose shards live on each host's addressable devices; ``globalize_batch``
+builds them from each host's local batch (the data-locality contract —
+workers never exchange raw examples, ``README.md:24``).
+"""
+
+import os
+from typing import Any, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# Environment contract written by tools/launch.py (and usable by hand).
+ENV_COORD = "PS_TPU_COORDINATOR"    # host:port of process 0
+ENV_NPROC = "PS_TPU_NUM_PROCESSES"
+ENV_PID = "PS_TPU_PROCESS_ID"
+ENV_PLATFORM = "PS_TPU_PLATFORM"        # e.g. "cpu" for simulated pods
+ENV_LOCAL_DEVICES = "PS_TPU_LOCAL_DEVICES"  # fake CPU devices per process
+
+
+def _apply_platform_overrides() -> None:
+    # Env vars alone are not enough on machines where a TPU plugin's
+    # sitecustomize force-sets jax_platforms at the config level (see
+    # tests/conftest.py); mirror the override into jax.config.
+    platform = os.environ.get(ENV_PLATFORM)
+    if platform:
+        jax.config.update("jax_platforms", platform)
+    n_local = os.environ.get(ENV_LOCAL_DEVICES)
+    if n_local:
+        jax.config.update("jax_num_cpu_devices", int(n_local))
+
+
+def initialize_from_env() -> bool:
+    """Call jax.distributed.initialize from the launcher's env contract.
+
+    Returns True if multi-process mode was initialized, False for the
+    single-process case (no env set). Safe to call twice.
+    """
+    _apply_platform_overrides()
+    coord = os.environ.get(ENV_COORD)
+    if not coord:
+        return False
+    from jax._src import distributed
+    if distributed.global_state.client is not None:
+        return True  # already initialized
+    jax.distributed.initialize(
+        coordinator_address=coord,
+        num_processes=int(os.environ[ENV_NPROC]),
+        process_id=int(os.environ[ENV_PID]),
+    )
+    return True
+
+
+def is_multiprocess() -> bool:
+    return jax.process_count() > 1
+
+
+def globalize_batch(mesh: Mesh, x_local: np.ndarray) -> jax.Array:
+    """Host-local batch shard -> global jax.Array sharded over 'data'.
+
+    Single-process this is a plain device_put; multi-process it assembles the
+    global array from per-process local data (each host contributes the rows
+    its mesh devices own).
+    """
+    sharding = NamedSharding(mesh, P("data"))
+    if jax.process_count() == 1:
+        return jax.device_put(x_local, sharding)
+    return jax.make_array_from_process_local_data(sharding, x_local)
+
+
+def globalize_replicated(mesh: Mesh, value: np.ndarray,
+                         spec: Optional[P] = None) -> jax.Array:
+    """Small host-identical array (e.g. the participation mask) -> global
+    array with the given spec (default: sharded over 'data'). Every host must
+    pass the same value."""
+    spec = P("data") if spec is None else spec
+    sharding = NamedSharding(mesh, spec)
+    value = np.asarray(value)
+    if jax.process_count() == 1:
+        return jax.device_put(value, sharding)
+    return jax.make_array_from_callback(value.shape, sharding,
+                                        lambda idx: value[idx])
+
+
+def all_replicated(mesh: Mesh, tree: Any) -> Any:
+    """Fetch a (possibly 'data'-sharded) pytree to every host as replicated
+    host-local numpy — used to pull replica-0 BN stats for checkpointing when
+    device 0 lives on another host."""
+    if jax.process_count() == 1:
+        return jax.device_get(tree)
+    from jax.experimental import multihost_utils
+    return jax.device_get(multihost_utils.process_allgather(tree, tiled=False))

@@ -48,15 +48,24 @@ def _model_collections(model, sample_shape, rng):
 def create_train_state(model, tx: optax.GradientTransformation, mesh: Mesh,
                        sample_shape, rng) -> TrainState:
     """Initialize replicated params/opt_state and per-replica batch_stats,
-    placed with the shardings make_train_step expects."""
+    placed with the shardings make_train_step expects.
+
+    The init runs *inside* jit with explicit out_shardings, so it produces
+    correctly placed global arrays in both single- and multi-process worlds
+    (a host-side init + device_put would not be legal across processes)."""
     n_data = mesh.shape["data"]
-    params, batch_stats = _model_collections(model, sample_shape, rng)
-    opt_state = tx.init(params)
-    batch_stats = jax.tree.map(
-        lambda a: jnp.tile(a[None], (n_data,) + (1,) * a.ndim), batch_stats)
-    state = TrainState(step=jnp.zeros((), jnp.int32), params=params,
-                       opt_state=opt_state, batch_stats=batch_stats)
-    return jax.device_put(state, state_shardings(mesh, state))
+
+    def init_fn(rng):
+        params, batch_stats = _model_collections(model, sample_shape, rng)
+        opt_state = tx.init(params)
+        batch_stats = jax.tree.map(
+            lambda a: jnp.tile(a[None], (n_data,) + (1,) * a.ndim), batch_stats)
+        return TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                          opt_state=opt_state, batch_stats=batch_stats)
+
+    shapes = jax.eval_shape(init_fn, rng)
+    shardings = state_shardings(mesh, shapes)
+    return jax.jit(init_fn, out_shardings=shardings)(rng)
 
 
 def state_specs(state: TrainState) -> TrainState:
@@ -72,6 +81,27 @@ def state_specs(state: TrainState) -> TrainState:
 def state_shardings(mesh: Mesh, state: TrainState) -> TrainState:
     return jax.tree.map(lambda s: NamedSharding(mesh, s), state_specs(state),
                         is_leaf=lambda x: isinstance(x, P))
+
+
+def place_state(mesh: Mesh, state: TrainState) -> TrainState:
+    """Host-local (numpy) TrainState -> correctly placed global arrays.
+
+    jit with out_shardings is the multi-process-legal way to do this (a bare
+    ``jax.device_put`` cannot target non-addressable devices); every process
+    must pass the same host-local values (true after load_checkpoint)."""
+    shardings = state_shardings(mesh, state)
+    return jax.jit(lambda s: s, out_shardings=shardings)(state)
+
+
+def fetch_replicated(mesh: Mesh, state: TrainState) -> TrainState:
+    """Global TrainState -> host-local numpy on EVERY process (batch_stats'
+    'data'-sharded leaves are gathered). The multi-process-safe inverse of
+    place_state, used for checkpointing and host-side eval."""
+    specs = jax.tree.map(lambda _: P(), state)
+    shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                             is_leaf=lambda x: isinstance(x, P))
+    replicated = jax.jit(lambda s: s, out_shardings=shardings)(state)
+    return jax.device_get(replicated)
 
 
 def make_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
@@ -119,8 +149,13 @@ def make_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
         denom = jnp.maximum(msum, 1.0)
         gavg = jax.tree.map(
             lambda g: jax.lax.psum(g * m, "data") / denom, grads)
-        updates, new_opt = tx.update(gavg, state.opt_state, state.params)
-        new_params = optax.apply_updates(state.params, updates)
+        if hasattr(tx, "apply"):
+            # Fused path (ops/fused_sgd.py): single-pass Pallas kernel
+            # replaces update + apply_updates.
+            new_params, new_opt = tx.apply(state.params, state.opt_state, gavg)
+        else:
+            updates, new_opt = tx.update(gavg, state.opt_state, state.params)
+            new_params = optax.apply_updates(state.params, updates)
         # An all-zero mask must be a true no-op: the reference master never
         # steps without K gradients (sync_replicas_master_nn.py:179,204-208);
         # without this guard momentum decay/step counters would still move.

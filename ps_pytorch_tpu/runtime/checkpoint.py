@@ -45,7 +45,14 @@ def save_checkpoint(train_dir: str, step: int, state: Any,
     if compress:
         from ps_pytorch_tpu.compression import w_compress
         blob = w_compress(np.frombuffer(blob, np.uint8), level=codec_level)
-    tmp = os.path.join(train_dir, f".tmp_{step}")
+    # Pid-suffixed tmp (a restarted writer must not collide with a stale tmp
+    # from a crashed predecessor); sweep any stale tmps for this step first
+    # so crash/restart cycles don't accumulate full serialized models.
+    import shutil
+    for name in os.listdir(train_dir):
+        if name.startswith(f".tmp_{step}_"):
+            shutil.rmtree(os.path.join(train_dir, name), ignore_errors=True)
+    tmp = os.path.join(train_dir, f".tmp_{step}_{os.getpid()}")
     final = checkpoint_path(train_dir, step)
     if os.path.exists(tmp):
         import shutil

@@ -50,6 +50,10 @@ class KVStore:
         with self._lock:
             return self._d.get(key, default)
 
+    def delete(self, key: str) -> None:
+        with self._lock:
+            self._d.pop(key, None)
+
 
 class DistributedKV(KVStore):
     """KV over the JAX coordination service (available after
@@ -64,19 +68,33 @@ class DistributedKV(KVStore):
         self._client = client
 
     def set(self, key: str, value: str) -> None:
-        self._client.key_value_set(key, value)
+        # Coordination-service keys are write-once by default; control-plane
+        # keys (step announce, durations) are deliberately last-writer-wins.
+        self._client.key_value_set(key, value, allow_overwrite=True)
 
     def get(self, key: str, default: Optional[str] = None) -> Optional[str]:
         try:
-            return self._client.blocking_key_value_get(key, 1000)
-        except Exception:
-            return default
+            return self._client.key_value_try_get(key)
+        except Exception as e:
+            # Only "key not published yet" maps to the default; a dead or
+            # unreachable coordination service must surface, not be polled.
+            if "NOT_FOUND" in str(e):
+                return default
+            raise
+
+    def delete(self, key: str) -> None:
+        try:
+            self._client.key_value_delete(key)
+        except Exception as e:
+            if "NOT_FOUND" not in str(e):
+                raise
 
 
 class Coordinator:
     def __init__(self, n_replicas: int, mode: str = "sync",
                  num_aggregate: int = 0, kill_threshold: float = 0.0,
-                 kv: Optional[KVStore] = None, run_id: str = "run"):
+                 kv: Optional[KVStore] = None, run_id: str = "run",
+                 leader: bool = True):
         if mode not in ("sync", "kofn", "async"):
             raise ValueError(f"unknown mode {mode!r}")
         if mode == "kofn" and not (0 < num_aggregate <= n_replicas):
@@ -88,6 +106,7 @@ class Coordinator:
         self.kill_threshold = kill_threshold
         self.kv = kv or KVStore()
         self.run_id = run_id
+        self.leader = leader
         # last observed per-replica step duration (telemetry; seconds)
         self._last_duration = np.zeros(n_replicas, np.float64)
         self._killed = np.zeros(n_replicas, bool)
@@ -126,8 +145,34 @@ class Coordinator:
         return self._last_duration
 
     # ---- participation policy (num_aggregate / tag 77 equivalents) ----
-    def participation_mask(self, step: int) -> np.ndarray:
-        """float32[n] mask for the next step's in-graph masked psum."""
+    def participation_mask(self, step: int, timeout_s: float = 300.0) -> np.ndarray:
+        """float32[n] mask for step ``step``'s in-graph masked psum.
+
+        Every participant in an SPMD step must consume the SAME mask or
+        parameters diverge, so exactly one coordinator (``leader=True``,
+        process 0) decides it and publishes it on the KV; followers block on
+        the published value — the announce/consume discipline of the
+        reference's tag-10 step broadcast, applied to the mask.
+        """
+        key = f"{self.run_id}/mask/{step}"
+        if not self.leader:
+            deadline = time.monotonic() + timeout_s
+            while True:
+                v = self.kv.get(key)
+                if v is not None:
+                    return np.asarray(json.loads(v), np.float32)
+                if time.monotonic() > deadline:
+                    raise TimeoutError(f"no mask published for step {step}")
+                time.sleep(0.002)
+        mask = self._decide_mask()
+        self.kv.set(key, json.dumps(mask.tolist()))
+        # GC: a mask is dead one step later; keep the KV O(1) over long runs
+        # (followers may still be reading step-1, so delete step-2).
+        if step >= 2:
+            self.kv.delete(f"{self.run_id}/mask/{step - 2}")
+        return mask
+
+    def _decide_mask(self) -> np.ndarray:
         mask = (~self._killed).astype(np.float32)
         if self.mode == "sync":
             return mask

@@ -42,10 +42,10 @@ class Evaluator:
         self.model = build_model(cfg.network, cfg.num_classes, cfg.compute_dtype)
         # Template state for deserialization; single-device mesh is fine here.
         mesh = make_mesh(data=1)
+        from ps_pytorch_tpu.data.datasets import sample_shape
         self.template = create_train_state(
             self.model, build_optimizer(cfg), mesh,
-            (1,) + {"MNIST": (28, 28, 1), "synthetic_mnist": (28, 28, 1)}.get(
-                cfg.dataset, (32, 32, 3)), jax.random.key(0))
+            (1,) + sample_shape(cfg.dataset), jax.random.key(0))
         _, self.test_loader = prepare_data(cfg, download=self.download)
         self.eval_fn = make_eval_step(self.model)
         self._built_for = config_json

@@ -26,17 +26,16 @@ from ps_pytorch_tpu.optim import build_optimizer
 from ps_pytorch_tpu.parallel import (
     create_train_state, make_eval_step, make_train_step, make_mesh,
 )
-from ps_pytorch_tpu.parallel.dp import replica0_batch_stats
+from ps_pytorch_tpu.parallel import dist
+from ps_pytorch_tpu.parallel.dp import (
+    fetch_replicated, place_state, replica0_batch_stats,
+)
 from ps_pytorch_tpu.parallel.mesh import local_data_shard
 from ps_pytorch_tpu.runtime import checkpoint as ckpt
 from ps_pytorch_tpu.runtime.coordinator import Coordinator
 from ps_pytorch_tpu.runtime.metrics import MetricsLogger
 
-_SAMPLE_SHAPES = {  # dataset -> single-example input shape
-    "MNIST": (28, 28, 1), "synthetic_mnist": (28, 28, 1),
-    "Cifar10": (32, 32, 3), "Cifar100": (32, 32, 3), "SVHN": (32, 32, 3),
-    "synthetic": (32, 32, 3),
-}
+from ps_pytorch_tpu.data.datasets import sample_shape
 
 
 class Trainer:
@@ -51,16 +50,28 @@ class Trainer:
         host_id, num_hosts = local_data_shard()
         self.train_loader, self.test_loader = prepare_data(
             cfg, host_id=host_id, num_hosts=num_hosts, download=download)
-        sample = (1,) + _SAMPLE_SHAPES[cfg.dataset]
+        sample = (1,) + sample_shape(cfg.dataset)
         self.state = create_train_state(self.model, self.tx, self.mesh, sample,
                                         jax.random.key(cfg.seed))
         self.step_fn = make_train_step(self.model, self.tx, self.mesh, self.state,
                                        sync_batchnorm=cfg.sync_batchnorm,
                                        remat=cfg.remat, donate=cfg.donate)
         self.eval_fn = make_eval_step(self.model)
-        self.coordinator = coordinator or Coordinator(
-            self.n_data, mode=cfg.mode, num_aggregate=cfg.num_aggregate,
-            kill_threshold=cfg.kill_threshold)
+        if coordinator is None:
+            kv = None
+            if dist.is_multiprocess():
+                from ps_pytorch_tpu.runtime.coordinator import DistributedKV
+                kv = DistributedKV()  # control plane over the coordination service
+            coordinator = Coordinator(
+                self.n_data, mode=cfg.mode, num_aggregate=cfg.num_aggregate,
+                kill_threshold=cfg.kill_threshold, kv=kv,
+                leader=jax.process_index() == 0)
+        self.coordinator = coordinator
+        # Data-axis replica indices whose devices live on this host (for
+        # duration telemetry feeding the kofn/deadline policies).
+        self._local_replicas = [
+            i for i, row in enumerate(self.mesh.devices)
+            if row.flat[0].process_index == jax.process_index()]
         self.metrics = MetricsLogger(cfg.metrics_file, cfg.log_every)
         self.start_step = 0
         if cfg.resume:
@@ -72,15 +83,27 @@ class Trainer:
         step = ckpt.latest_step(self.cfg.train_dir)
         if step is None:
             return
-        state, meta, _ = ckpt.load_checkpoint(self.cfg.train_dir, step, self.state)
-        from ps_pytorch_tpu.parallel.dp import state_shardings
-        self.state = jax.device_put(state, state_shardings(self.mesh, state))
+        template = fetch_replicated(self.mesh, self.state) \
+            if dist.is_multiprocess() else self.state
+        state, meta, _ = ckpt.load_checkpoint(self.cfg.train_dir, step, template)
+        self.state = place_state(self.mesh, state)
         self.start_step = int(meta["step"])
         print(f"RESUME from {ckpt.checkpoint_path(self.cfg.train_dir, step)} "
               f"at step {self.start_step}")
 
     def _checkpoint(self, step: int) -> None:
-        ckpt.save_checkpoint(self.cfg.train_dir, step, self.state,
+        # Multi-process: gather 'data'-sharded BN leaves (a collective — every
+        # host participates), then ONLY process 0 writes. The reference had
+        # every worker overwrite the same NFS file (distributed_worker.py:
+        # 175-177); replaying that on a shared filesystem races rmtree/rename
+        # between hosts, so checkpoint authority stays with the leader.
+        if dist.is_multiprocess():
+            state = fetch_replicated(self.mesh, self.state)
+            if jax.process_index() != 0:
+                return
+        else:
+            state = self.state
+        ckpt.save_checkpoint(self.cfg.train_dir, step, state,
                              config_json=self.cfg.to_json(),
                              compress=self.cfg.compress_grad,
                              codec_level=self.cfg.codec_level)
@@ -101,9 +124,15 @@ class Trainer:
             x, y = self.train_loader.next_batch()
             t_data = time.monotonic() - t0
             mask = self.coordinator.participation_mask(step)
+            # Legacy uint32[2] key: globalizable as a plain replicated array
+            # (typed key dtypes can't cross make_array_from_callback).
+            key = np.asarray(jax.random.PRNGKey(cfg.seed * 100003 + step))
             new_state, m = self.step_fn(
-                self.state, jnp.asarray(x), jnp.asarray(y),
-                jnp.asarray(mask), jax.random.key(cfg.seed * 100003 + step))
+                self.state,
+                dist.globalize_batch(self.mesh, np.asarray(x)),
+                dist.globalize_batch(self.mesh, np.asarray(y)),
+                dist.globalize_replicated(self.mesh, np.asarray(mask, np.float32)),
+                dist.globalize_replicated(self.mesh, key, spec=jax.sharding.PartitionSpec()))
             self.state = new_state
             if step % cfg.log_every == 0 or step == last_step:
                 # Materializing metrics syncs the device; skip between logs.
@@ -115,7 +144,8 @@ class Trainer:
                 self.metrics.log_step(step, epoch, loss=loss, acc=acc,
                                       participating=part, step_time=t_step,
                                       data_time=t_data)
-                self.coordinator.report_duration(0, step, t_step)
+                for r in self._local_replicas:
+                    self.coordinator.report_duration(r, step, t_step)
             if cfg.eval_freq > 0 and step % cfg.eval_freq == 0:
                 self._checkpoint(step)
         jax.block_until_ready(self.state.params)
@@ -127,8 +157,15 @@ class Trainer:
     def evaluate(self, max_batches: Optional[int] = None) -> dict:
         """Top-1/top-5/loss over the test loader (reference
         ``_evaluate_model``, ``distributed_evaluator.py:90-106``)."""
-        params = self.state.params
-        bstats = replica0_batch_stats(self.state)
+        if dist.is_multiprocess():
+            # Host-local copies: each host evaluates the full test set locally
+            # (the reference evaluator is likewise a standalone local process).
+            st = fetch_replicated(self.mesh, self.state)
+            params = st.params
+            bstats = jax.tree.map(lambda a: a[0], st.batch_stats)
+        else:
+            params = self.state.params
+            bstats = replica0_batch_stats(self.state)
         tot = {"sum_loss": 0.0, "top1": 0, "top5": 0, "count": 0}
         for i, (x, y) in enumerate(self.test_loader.epoch(0)):
             if max_batches is not None and i >= max_batches:

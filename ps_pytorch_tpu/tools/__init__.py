@@ -1,0 +1,9 @@
+"""Operational tooling — the L6/L7 layer (SURVEY §1).
+
+Replaces the reference's shell + EC2 stack: ``run_pytorch.sh`` / ``mpirun``
+(job launch), ``tools/pytorch_ec2.py`` ``run_command``/``kill_all_python``/
+idle detection (fleet control), ``killall.sh`` (kill), ``tune.sh`` +
+``tiny_tuning_parser.py`` (LR sweeps), ``data_prepare.sh`` (dataset
+pre-download), and the ``analysis/*.ipynb`` regex pipelines (speedup
+reports). Everything here is a ``python -m ps_pytorch_tpu.tools.<name>`` CLI.
+"""

@@ -1,0 +1,135 @@
+#!/usr/bin/env python
+"""Log -> scaling/speedup analysis.
+
+Replaces the reference's offline notebooks (``analysis/Speedup_Comparisons_
+LeNet.ipynb``, ``analysis/Speedups_with_GradCompression.ipynb``), which
+regex-parse per-worker stdout logs into per-step times and report two curves
+per cluster size (SURVEY §6): "normal" speedup (slowest worker's step time —
+what the synchronous system actually achieves) and "ideal" speedup (fastest
+worker — what it could achieve with perfect straggler mitigation).
+
+Input: one or more runs, each a set of STEP-line logs or metrics JSONL files
+(multiple files per run = one per host). Per step, the max step_time across
+files is the "normal" time and the min is the "ideal" time — exactly the
+notebooks' max/min-per-step computation. Speedups are reported against the
+run labeled as baseline (default: the smallest device count).
+
+    python -m ps_pytorch_tpu.tools.analyze 1=logs/n1.jsonl 8=logs/n8_host*.log
+"""
+
+import argparse
+import glob
+import json
+import statistics
+import sys
+from typing import Dict, List
+
+from ps_pytorch_tpu.runtime.metrics import parse_line
+
+
+def read_records(path: str) -> List[dict]:
+    """STEP-schema log or metrics JSONL -> list of step records."""
+    records = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            if line.startswith("{"):
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if "step" in rec and "step_time" in rec:
+                    records.append(rec)
+                continue
+            rec = parse_line(line)
+            if rec:
+                records.append(rec)
+    return records
+
+
+def per_step_times(paths: List[str], skip_first: int = 1) -> Dict[str, float]:
+    """-> {"normal": mean slowest-host step time, "ideal": mean fastest,
+    "steps": N}. skip_first drops compile-dominated steps."""
+    by_step: Dict[int, List[float]] = {}
+    for path in paths:
+        for rec in read_records(path):
+            by_step.setdefault(rec["step"], []).append(rec["step_time"])
+    steps = sorted(by_step)[skip_first:]
+    if not steps:
+        raise ValueError(f"no step records found in {paths}")
+    normal = statistics.fmean(max(by_step[s]) for s in steps)
+    ideal = statistics.fmean(min(by_step[s]) for s in steps)
+    return {"normal": normal, "ideal": ideal, "steps": len(steps)}
+
+
+def analyze(runs: Dict[str, List[str]], baseline: str = "",
+            skip_first: int = 1) -> List[dict]:
+    """runs: label -> list of files. Labels sort numerically when possible."""
+    def key(label: str):
+        try:
+            return (0, float(label))
+        except ValueError:
+            return (1, label)
+
+    labels = sorted(runs, key=key)
+    stats = {l: per_step_times(runs[l], skip_first) for l in labels}
+    base = baseline or labels[0]
+    b = stats[base]
+    rows = []
+    for l in labels:
+        s = stats[l]
+        rows.append({
+            "run": l, "steps": s["steps"],
+            "step_time_normal_s": round(s["normal"], 5),
+            "step_time_ideal_s": round(s["ideal"], 5),
+            "speedup_normal": round(b["normal"] / s["normal"], 3),
+            "speedup_ideal": round(b["ideal"] / s["ideal"], 3),
+        })
+    return rows
+
+
+def to_markdown(rows: List[dict]) -> str:
+    """BASELINE.md-compatible table."""
+    head = ("| run | steps | step time (normal) | step time (ideal) | "
+            "speedup (normal) | speedup (ideal) |")
+    sep = "|---|---|---|---|---|---|"
+    body = [
+        f"| {r['run']} | {r['steps']} | {r['step_time_normal_s']:.5f} s "
+        f"| {r['step_time_ideal_s']:.5f} s | {r['speedup_normal']:.2f}x "
+        f"| {r['speedup_ideal']:.2f}x |"
+        for r in rows]
+    return "\n".join([head, sep] + body)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("runs", nargs="+",
+                   help="LABEL=GLOB pairs, e.g. 1=n1.jsonl 8='n8_host*.log'")
+    p.add_argument("--baseline", default="", help="label to normalize against")
+    p.add_argument("--skip-first", type=int, default=1)
+    p.add_argument("--json", action="store_true", help="emit JSON rows instead")
+    args = p.parse_args(argv)
+
+    runs: Dict[str, List[str]] = {}
+    for spec in args.runs:
+        label, _, pattern = spec.partition("=")
+        if not pattern:
+            p.error(f"run spec {spec!r} is not LABEL=GLOB")
+        files = sorted(glob.glob(pattern))
+        if not files:
+            p.error(f"no files match {pattern!r}")
+        runs.setdefault(label, []).extend(files)
+
+    rows = analyze(runs, baseline=args.baseline, skip_first=args.skip_first)
+    if args.json:
+        for r in rows:
+            print(json.dumps(r))
+    else:
+        print(to_markdown(rows))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""Learning-rate sweep harness.
+
+Replaces ``tune.sh`` + ``tiny_tuning_parser.py``: the reference grid-sweeps
+seven learning rates by re-launching a 17-rank mpirun job per value
+(``tune.sh:1-36``) and regex-averages the step-N loss across its 16 workers
+(``tiny_tuning_parser.py:14-26``). Here each trial is one subprocess running
+the SPMD trainer; the loss at the probe step is parsed from the stable STEP
+line schema (``runtime/metrics.py``) — no fragile ad-hoc regex, and the
+parser is shared with the analysis tooling.
+
+    python -m ps_pytorch_tpu.tools.sweep --lrs 0.01,0.05,0.1 --probe-step 20 \
+        -- --network LeNet --dataset synthetic_mnist --batch-size 256
+
+Prints one JSON line per trial and a final ``BEST`` line.
+"""
+
+import argparse
+import json
+import statistics
+import subprocess
+import sys
+from typing import List, Optional
+
+from ps_pytorch_tpu.runtime.metrics import parse_line
+
+
+def run_trial(lr: float, probe_step: int, train_argv: List[str],
+              entry: str = "train.py", avg_last: int = 1,
+              extra_env: Optional[dict] = None) -> dict:
+    """One training subprocess at this lr; -> {"lr", "loss", "acc", "steps"}."""
+    import os
+    cmd = [sys.executable, entry, "--lr", str(lr),
+           "--max-steps", str(probe_step), "--log-every", "1",
+           "--eval-freq", "0", "--resume", "false"] + train_argv
+    env = dict(os.environ)
+    env.update(extra_env or {})
+    out = subprocess.run(cmd, capture_output=True, text=True, env=env)
+    records = [r for r in (parse_line(l) for l in out.stdout.splitlines()) if r]
+    if out.returncode != 0 or not records:
+        return {"lr": lr, "loss": float("nan"), "acc": float("nan"),
+                "steps": len(records), "error": out.stderr[-500:]}
+    # Average the last k probe losses (the reference averages its 16 workers'
+    # step-N lines; one SPMD process emits one line per step, so average over
+    # trailing steps for the same smoothing effect).
+    tail = records[-avg_last:]
+    return {"lr": lr, "loss": statistics.fmean(r["loss"] for r in tail),
+            "acc": statistics.fmean(r["acc"] for r in tail),
+            "steps": len(records)}
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if "--" in argv:
+        i = argv.index("--")
+        argv, train_argv = argv[:i], argv[i + 1:]
+    else:
+        train_argv = []
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--lrs", default="0.005,0.01,0.02,0.05,0.1,0.2,0.4",
+                   help="comma-separated grid (7 values, like tune.sh)")
+    p.add_argument("--probe-step", type=int, default=20,
+                   help="train this many steps; rank by loss there")
+    p.add_argument("--avg-last", type=int, default=3)
+    p.add_argument("--entry", default="train.py")
+    args = p.parse_args(argv)
+
+    results = []
+    for lr in (float(s) for s in args.lrs.split(",")):
+        r = run_trial(lr, args.probe_step, train_argv, entry=args.entry,
+                      avg_last=args.avg_last)
+        print(json.dumps(r))
+        results.append(r)
+    valid = [r for r in results if r["loss"] == r["loss"]]  # drop NaNs
+    if not valid:
+        print("BEST none (all trials failed)", file=sys.stderr)
+        return 1
+    best = min(valid, key=lambda r: r["loss"])
+    print(f"BEST lr={best['lr']:g} loss={best['loss']:.6f} acc={best['acc']:.4f}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -21,6 +21,11 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running integration test (multi-process launch)")
+
+
 @pytest.fixture(scope="session")
 def mesh8():
     from ps_pytorch_tpu.parallel import make_mesh

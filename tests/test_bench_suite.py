@@ -1,0 +1,36 @@
+"""Bench-suite smoke: each config builds and times real steps on the fake
+8-device CPU mesh (tiny step counts; correctness of the harness, not speed)."""
+
+import jax
+
+from bench_suite import CONFIGS, bench_throughput, bench_time_to_loss
+
+
+def test_lenet_dp_config_runs():
+    r = bench_throughput("lenet_mnist_dp", "LeNet", "synthetic_mnist", 16, 2)
+    assert r["devices"] == 8 and r["global_batch"] == 128
+    assert r["images_per_sec"] > 0
+
+
+def test_kofn_config_masks():
+    r = bench_throughput("vgg11_cifar100_kofn", "VGG11", "synthetic", 4, 1,
+                         mode="kofn", num_aggregate=7)
+    assert r["images_per_sec"] > 0
+
+
+def test_single_device_config():
+    r = bench_throughput("lenet_mnist_single", "LeNet", "synthetic_mnist",
+                         16, 1, n_devices=1)
+    assert r["devices"] == 1
+
+
+def test_convergence_probe():
+    r = bench_time_to_loss("lenet_convergence", "LeNet", "synthetic_mnist",
+                           64, target_loss=100.0, max_steps=10)
+    assert r["converged"] and r["steps"] <= 10
+
+
+def test_all_configs_registered():
+    assert set(CONFIGS) >= {
+        "lenet_mnist_single", "lenet_mnist_dp", "resnet18_cifar10_dp",
+        "vgg11_cifar100_kofn", "resnet50_imagenet", "lenet_convergence"}

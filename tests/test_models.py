@@ -54,6 +54,22 @@ def test_vgg_num_classes():
     assert out.shape == (1, 100)
 
 
+def test_resnet50_imagenet_stem():
+    # 224px stem: 7x7/s2 conv + maxpool + global average pool. Param count
+    # matches torchvision resnet50 (25,557,032 incl. fc for 1000 classes).
+    variables, out = _init_and_apply("ResNet50_ImageNet", (1, 224, 224, 3),
+                                     num_classes=1000)
+    assert out.shape == (1, 1000)
+    assert n_params(variables["params"]) == 25557032
+
+
+def test_imagenet_stem_downsamples():
+    # 224 -> 7x7 before pooling; spatial-size independence of the head means
+    # a 32px input also works (used by eval templates).
+    _, out = _init_and_apply("ResNet18_ImageNet", (1, 32, 32, 3), num_classes=7)
+    assert out.shape == (1, 7)
+
+
 def test_registry_covers_reference_families():
     names = model_names()
     for required in ["LeNet", "ResNet18", "ResNet34", "ResNet50", "ResNet101",

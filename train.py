@@ -19,8 +19,15 @@ import sys
 
 def main(argv=None) -> int:
     from ps_pytorch_tpu.config import config_from_args
+    from ps_pytorch_tpu.parallel import dist
     from ps_pytorch_tpu.runtime import Trainer
 
+    # Multi-host bootstrap (no mpirun): tools/launch.py exports the env
+    # contract; single-process runs skip this.
+    if dist.initialize_from_env():
+        import jax
+        print(f"DIST process {jax.process_index()}/{jax.process_count()} "
+              f"local_devices={jax.local_device_count()}")
     cfg = config_from_args(argv)
     print(f"CONFIG {cfg.to_json()}")
     trainer = Trainer(cfg)
