@@ -1,0 +1,82 @@
+"""Decoder-only transformer LM with pluggable attention parallelism.
+
+Beyond-parity model family (the reference is CNN-only, SURVEY §5.7): a
+GPT-style causal LM whose attention can run (a) unsharded ("full") or
+(b) as ring attention over a mesh axis ("ring", ``parallel/ring.py``) when
+the module is applied inside ``shard_map`` with the sequence axis sharded —
+the long-context training path (``parallel/sp.py``).
+
+Everything except attention is per-token (LayerNorm, MLP, embeddings), so
+the module body is identical in both modes; only the attention exchange
+crosses shards. Learned positional embeddings are indexed by GLOBAL token
+position, passed in by the caller (the sp step knows each shard's offset).
+"""
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ps_pytorch_tpu.parallel.ring import full_attention, ring_attention
+
+
+class Block(nn.Module):
+    n_heads: int
+    d_model: int
+    dtype: Any = jnp.float32
+    attention_impl: str = "full"      # "full" | "ring"
+    axis_name: str = "data"
+
+    @nn.compact
+    def __call__(self, x):
+        # x: [B, S_local, D]
+        b, s, d = x.shape
+        h = self.n_heads
+        hd = d // h
+        y = nn.LayerNorm(dtype=self.dtype)(x)
+        qkv = nn.Dense(3 * d, use_bias=False, dtype=self.dtype)(y)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        to_heads = lambda t: t.reshape(b, s, h, hd).transpose(0, 2, 1, 3)
+        q, k, v = to_heads(q), to_heads(k), to_heads(v)
+        if self.attention_impl == "ring":
+            o = ring_attention(q, k, v, self.axis_name, causal=True)
+        else:
+            o = full_attention(q, k, v, causal=True)
+        o = o.transpose(0, 2, 1, 3).reshape(b, s, d)
+        x = x + nn.Dense(d, use_bias=False, dtype=self.dtype)(o)
+        y = nn.LayerNorm(dtype=self.dtype)(x)
+        y = nn.Dense(4 * d, dtype=self.dtype)(y)
+        y = nn.gelu(y)
+        x = x + nn.Dense(d, dtype=self.dtype)(y)
+        return x
+
+
+class TransformerLM(nn.Module):
+    vocab_size: int = 256
+    n_layers: int = 2
+    n_heads: int = 4
+    d_model: int = 128
+    max_seq_len: int = 2048
+    dtype: Any = jnp.float32
+    attention_impl: str = "full"
+    axis_name: str = "data"
+
+    @nn.compact
+    def __call__(self, tokens, positions: Optional[jax.Array] = None,
+                 train: bool = True):
+        # tokens: [B, S_local] int32; positions: [S_local] global positions
+        # (defaults to 0..S-1 — correct only when unsharded).
+        if positions is None:
+            positions = jnp.arange(tokens.shape[1])
+        x = nn.Embed(self.vocab_size, self.d_model, dtype=self.dtype,
+                     name="tok_embed")(tokens)
+        x = x + nn.Embed(self.max_seq_len, self.d_model, dtype=self.dtype,
+                         name="pos_embed")(positions)[None]
+        for i in range(self.n_layers):
+            x = Block(self.n_heads, self.d_model, self.dtype,
+                      self.attention_impl, self.axis_name, name=f"block_{i}")(x)
+        x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
+        logits = nn.Dense(self.vocab_size, use_bias=False, dtype=self.dtype,
+                          name="lm_head")(x)
+        return logits.astype(jnp.float32)

@@ -1,0 +1,118 @@
+"""Sequence-parallel (long-context) training step.
+
+The long-context counterpart of ``parallel/dp.py``: instead of sharding the
+batch, the SEQUENCE axis of every example is sharded over the mesh's 'data'
+axis, attention runs as a ring (``parallel/ring.py``), and each shard
+computes the next-token loss for its local tokens; gradients are summed with
+``psum`` exactly like the data-parallel path — one jitted shard_map, params
+replicated, collectives on ICI. The reference has no equivalent capability
+(SURVEY §5.7); this is where the framework exceeds it.
+
+Loss detail at the shard boundary: shard i needs token 1 of shard i+1 as the
+target for its last local position, obtained with a single ppermute of the
+first local token — no overlap halo, no gather.
+"""
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ps_pytorch_tpu.parallel.dp import TrainState
+
+
+def create_lm_train_state(model, tx, mesh: Mesh, sample_tokens,
+                          rng: Optional[jax.Array] = None) -> TrainState:
+    """Replicated params/opt_state for the LM (no batch_stats)."""
+    # Ring attention needs a bound mesh axis; init runs under plain jit, so
+    # use a full-attention clone — the parameter tree is identical.
+    init_model = model
+    if getattr(model, "attention_impl", "full") == "ring":
+        init_model = model.clone(attention_impl="full")
+    if rng is None:
+        rng = jax.random.key(0)
+    # Param shapes don't depend on sequence length (pos_embed is sized by
+    # max_seq_len), so init at a short dummy length: running full attention
+    # at the caller's global S would materialize [S, S] — OOM in exactly the
+    # long-context regime this module exists for.
+    init_len = min(sample_tokens[1], 128)
+
+    def init_fn(rng):
+        variables = init_model.init(
+            rng, jnp.zeros((sample_tokens[0], init_len), jnp.int32),
+            positions=jnp.arange(init_len))
+        params = variables["params"]
+        return TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                          opt_state=tx.init(params), batch_stats={})
+
+    shapes = jax.eval_shape(init_fn, rng)
+    specs = TrainState(step=P(), params=jax.tree.map(lambda _: P(), shapes.params),
+                       opt_state=jax.tree.map(lambda _: P(), shapes.opt_state),
+                       batch_stats={})
+    shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                             is_leaf=lambda x: isinstance(x, P))
+    return jax.jit(init_fn, out_shardings=shardings)(rng)
+
+
+def make_sp_train_step(model, tx, mesh: Mesh, *, axis_name: str = "data",
+                       donate: bool = True) -> Callable:
+    """-> step_fn(state, tokens) -> (state, metrics).
+
+    tokens: [B, S] global int32, S sharded over ``axis_name``. The model must
+    be built with ``attention_impl='ring'`` and the same ``axis_name``.
+    (No rng parameter: the LM has no dropout yet; add an ``rngs`` dict to the
+    apply call when it does.)
+    """
+
+    def local_step(state, tokens):
+        n = jax.lax.axis_size(axis_name)
+        idx = jax.lax.axis_index(axis_name)
+        s_local = tokens.shape[1]
+        positions = idx * s_local + jnp.arange(s_local)
+
+        def loss_fn(params):
+            # LOCAL loss sum only — no collective inside the differentiated
+            # function (differentiating through an in-loss psum double-counts
+            # cross-shard cotangents); normalization and the cross-shard sum
+            # happen on the gradient afterwards.
+            logits = model.apply({"params": params}, tokens,
+                                 positions=positions)
+            # Next-token targets: local shift; the boundary target (first
+            # token of the next shard) arrives via one ppermute hop.
+            perm = [(j, (j - 1) % n) for j in range(n)]
+            first_next = jax.lax.ppermute(tokens[:, :1], axis_name, perm)
+            targets = jnp.concatenate([tokens[:, 1:], first_next], axis=1)
+            per_tok = optax.softmax_cross_entropy_with_integer_labels(
+                logits, targets)
+            # The global last token has no target: weight it out.
+            is_global_last = positions == (n * s_local - 1)
+            w = jnp.where(is_global_last, 0.0, 1.0)[None, :]
+            loss_sum = jnp.sum(per_tok * w)
+            count = jnp.sum(w) * tokens.shape[0]
+            return loss_sum, count
+
+        (loss_sum, count), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state.params)
+        total = jax.lax.psum(count, axis_name)
+        # Params are replicated, so each shard's backprop yields only the
+        # contribution of computational paths through that shard (ring
+        # ppermutes transpose to reverse ppermutes); the full mean-loss
+        # gradient is their sum over the global token count.
+        grads = jax.tree.map(
+            lambda g: jax.lax.psum(g, axis_name) / total, grads)
+        loss = jax.lax.psum(loss_sum, axis_name) / total
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        new_state = state.replace(step=state.step + 1, params=new_params,
+                                  opt_state=new_opt)
+        return new_state, {"loss": loss}
+
+    specs = TrainState(step=P(), params=P(), opt_state=P(), batch_stats={})
+    sharded = jax.shard_map(
+        local_step, mesh=mesh,
+        in_specs=(specs, P(None, axis_name)),
+        out_specs=(specs, P()),
+        check_vma=False)
+    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
