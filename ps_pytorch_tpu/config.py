@@ -59,6 +59,7 @@ class TrainConfig:
     data_axis: int = 0               # number of data-parallel shards; 0 = all local devices
     model_axis: int = 1              # reserved mesh axis for TP (unused by these models)
     sync_batchnorm: bool = False     # reference keeps BN stats worker-local (distributed_worker.py:245-252)
+    shard_update: bool = False       # ZeRO-1 cross-replica sharded weight update (parallel/zero.py)
 
     # -- numerics / TPU --
     compute_dtype: str = "bfloat16"  # MXU-native compute dtype; params stay float32
@@ -70,9 +71,11 @@ class TrainConfig:
     compress_grad: bool = False      # compress DCN-crossing gradient mirrors / checkpoints
     codec_level: int = 3
 
-    # -- logging --
+    # -- logging / profiling --
     log_every: int = 1
     metrics_file: str = ""          # optional JSONL metrics sink ("" = stdout only)
+    profile_dir: str = ""           # jax.profiler trace output ("" = off; SURVEY §5.1)
+    profile_steps: str = "10-12"    # inclusive step range to trace, "start-end"
 
     def __post_init__(self) -> None:
         if self.num_classes == 0:

@@ -32,17 +32,27 @@ import numpy as np
 class StaleGradientAggregator:
     def __init__(self, n_slices: int, staleness_limit: int = 4,
                  staleness_decay: float = 0.0, num_aggregate: int = 0,
-                 compress: bool = False, codec_level: int = 3):
+                 compress: bool = False, codec_level: int = 3,
+                 codec: str = "blosc"):
         if n_slices < 1:
             raise ValueError("need at least one slice")
         if num_aggregate > n_slices:
             raise ValueError(f"num_aggregate {num_aggregate} > n_slices {n_slices}")
+        if codec not in ("blosc", "int8"):
+            raise ValueError(f"unknown codec {codec!r} (blosc | int8)")
         self.n = n_slices
         self.limit = staleness_limit
         self.decay = staleness_decay
         self.k = num_aggregate
         self.compress = compress
         self.codec_level = codec_level
+        # "blosc": lossless host-side byte compression (native C++,
+        #          compression/ — the reference's --compress-grad semantics).
+        # "int8":  lossy-but-unbiased ON-DEVICE quantization (Pallas,
+        #          ops/quantize.py) — 4x smaller before the bytes ever leave
+        #          the chip; the TPU-native option the reference had no
+        #          equivalent of.
+        self.codec = codec
         # slice_id -> (step, leaves or compressed leaves, treedef)
         self._pool: Dict[int, Tuple[int, List[Any], Any]] = {}
 
@@ -52,18 +62,30 @@ class StaleGradientAggregator:
         if not (0 <= slice_id < self.n):
             raise ValueError(f"slice_id {slice_id} out of range")
         leaves, treedef = jax.tree.flatten(grads)
-        leaves = [np.asarray(l) for l in leaves]
-        if self.compress:
-            from ps_pytorch_tpu.compression import g_compress
-            leaves = [g_compress(l, level=self.codec_level) for l in leaves]
+        if self.compress and self.codec == "int8":
+            from ps_pytorch_tpu.ops import quantize_int8
+            key = jax.random.key((hash((slice_id, step)) & 0x7FFFFFFF))
+            leaves = [quantize_int8(l, jax.random.fold_in(key, i))
+                      for i, l in enumerate(leaves)]
+        else:
+            leaves = [np.asarray(l) for l in leaves]
+            if self.compress:
+                from ps_pytorch_tpu.compression import g_compress
+                leaves = [g_compress(l, level=self.codec_level) for l in leaves]
         self._pool[slice_id] = (step, leaves, treedef)
 
     def wire_bytes(self) -> int:
         """Bytes currently pooled (what crossed / would cross DCN)."""
+        from ps_pytorch_tpu.ops.quantize import QuantizedTensor, quantized_nbytes
         total = 0
         for _, leaves, _ in self._pool.values():
             for l in leaves:
-                total += len(l) if isinstance(l, (bytes, bytearray)) else l.nbytes
+                if isinstance(l, QuantizedTensor):
+                    total += quantized_nbytes(l)
+                elif isinstance(l, (bytes, bytearray)):
+                    total += len(l)
+                else:
+                    total += l.nbytes
         return total
 
     def collect(self, current_step: int) -> Tuple[Optional[Any], dict]:
@@ -92,7 +114,10 @@ class StaleGradientAggregator:
         for staleness, sid, leaves, treedef in fresh:
             w = self.decay ** staleness if self.decay > 0 else 1.0
             weights[sid] = w
-            if self.compress:
+            if self.compress and self.codec == "int8":
+                from ps_pytorch_tpu.ops import dequantize_int8
+                leaves = [np.asarray(dequantize_int8(l)) for l in leaves]
+            elif self.compress:
                 from ps_pytorch_tpu.compression import g_decompress
                 leaves = [g_decompress(l) for l in leaves]
             if acc is None:

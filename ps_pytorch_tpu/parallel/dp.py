@@ -78,19 +78,64 @@ def state_specs(state: TrainState) -> TrainState:
     )
 
 
-def state_shardings(mesh: Mesh, state: TrainState) -> TrainState:
-    return jax.tree.map(lambda s: NamedSharding(mesh, s), state_specs(state),
+def state_shardings(mesh: Mesh, state: TrainState, specs=None) -> TrainState:
+    """PartitionSpec pytree -> NamedSharding pytree; ``specs`` overrides the
+    default data-parallel placement (e.g. zero_state_specs)."""
+    specs = state_specs(state) if specs is None else specs
+    return jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
                         is_leaf=lambda x: isinstance(x, P))
 
 
-def place_state(mesh: Mesh, state: TrainState) -> TrainState:
+def make_loss_fn(model, has_bn: bool):
+    """The per-replica supervised loss shared by the DP and ZeRO steps:
+    cross-entropy + accuracy, BN batch_stats threaded when present."""
+
+    def loss_fn(params, bs_local, x, y, rng):
+        variables = {"params": params}
+        if has_bn:
+            variables["batch_stats"] = bs_local
+        # Unused rngs are ignored by flax, so pass dropout unconditionally.
+        kw = dict(train=True, rngs={"dropout": rng})
+        if has_bn:
+            logits, mut = model.apply(variables, x, mutable=["batch_stats"], **kw)
+            new_bs = mut["batch_stats"]
+        else:
+            logits = model.apply(variables, x, **kw)
+            new_bs = bs_local
+        loss = optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+        acc = jnp.mean(jnp.argmax(logits, -1) == y)
+        return loss, (new_bs, acc)
+
+    return loss_fn
+
+
+def apply_optimizer(tx, params, opt_state, grads):
+    """update+apply for optax transforms, or the fused single-pass kernel
+    when the optimizer exposes ``apply`` (ops/fused_sgd.FusedSGD)."""
+    if hasattr(tx, "apply"):
+        return tx.apply(params, opt_state, grads)
+    updates, new_opt = tx.update(grads, opt_state, params)
+    return optax.apply_updates(params, updates), new_opt
+
+
+def masked_metrics(loss, acc, m, denom, msum):
+    return {
+        "loss": jax.lax.psum(loss * m, "data") / denom,
+        "accuracy": jax.lax.psum(acc * m, "data") / denom,
+        "participating": msum,
+    }
+
+
+def place_state(mesh: Mesh, state: TrainState, specs=None) -> TrainState:
     """Host-local (numpy) TrainState -> correctly placed global arrays.
 
     jit with out_shardings is the multi-process-legal way to do this (a bare
     ``jax.device_put`` cannot target non-addressable devices); every process
-    must pass the same host-local values (true after load_checkpoint)."""
-    shardings = state_shardings(mesh, state)
-    return jax.jit(lambda s: s, out_shardings=shardings)(state)
+    must pass the same host-local values (true after load_checkpoint).
+    ``specs`` overrides the placement (e.g. zero_state_specs for the
+    sharded-weight-update layout)."""
+    return jax.jit(lambda s: s,
+                   out_shardings=state_shardings(mesh, state, specs))(state)
 
 
 def fetch_replicated(mesh: Mesh, state: TrainState) -> TrainState:
@@ -117,23 +162,7 @@ def make_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
     metrics: dict of replicated scalars (loss, accuracy, participating).
     """
     has_bn = bool(jax.tree.leaves(state.batch_stats))
-
-    def loss_fn(params, bs_local, x, y, rng):
-        variables = {"params": params}
-        if has_bn:
-            variables["batch_stats"] = bs_local
-        # Unused rngs are ignored by flax, so pass dropout unconditionally.
-        kw = dict(train=True, rngs={"dropout": rng})
-        if has_bn:
-            logits, mut = model.apply(variables, x, mutable=["batch_stats"], **kw)
-            new_bs = mut["batch_stats"]
-        else:
-            logits = model.apply(variables, x, **kw)
-            new_bs = bs_local
-        loss = optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
-        acc = jnp.mean(jnp.argmax(logits, -1) == y)
-        return loss, (new_bs, acc)
-
+    loss_fn = make_loss_fn(model, has_bn)
     vg = jax.value_and_grad(
         jax.checkpoint(loss_fn) if remat else loss_fn, has_aux=True)
 
@@ -149,13 +178,8 @@ def make_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
         denom = jnp.maximum(msum, 1.0)
         gavg = jax.tree.map(
             lambda g: jax.lax.psum(g * m, "data") / denom, grads)
-        if hasattr(tx, "apply"):
-            # Fused path (ops/fused_sgd.py): single-pass Pallas kernel
-            # replaces update + apply_updates.
-            new_params, new_opt = tx.apply(state.params, state.opt_state, gavg)
-        else:
-            updates, new_opt = tx.update(gavg, state.opt_state, state.params)
-            new_params = optax.apply_updates(state.params, updates)
+        new_params, new_opt = apply_optimizer(
+            tx, state.params, state.opt_state, gavg)
         # An all-zero mask must be a true no-op: the reference master never
         # steps without K gradients (sync_replicas_master_nn.py:179,204-208);
         # without this guard momentum decay/step counters would still move.
@@ -169,11 +193,7 @@ def make_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
             # the synced stats (same discipline as the gradient path).
             new_bs = jax.tree.map(
                 lambda a: jax.lax.psum(a * m, "data") / denom, new_bs)
-        metrics = {
-            "loss": jax.lax.psum(loss * m, "data") / denom,
-            "accuracy": jax.lax.psum(acc * m, "data") / denom,
-            "participating": msum,
-        }
+        metrics = masked_metrics(loss, acc, m, denom, msum)
         new_state = state.replace(
             step=state.step + 1, params=new_params, opt_state=new_opt,
             batch_stats=jax.tree.map(lambda a: a[None], new_bs))

@@ -1,0 +1,150 @@
+"""Cross-replica sharded weight update (ZeRO-1 / XLA weight-update sharding).
+
+Implements the technique of "Automatic Cross-Replica Sharding of Weight
+Update in Data-Parallel Training" (arXiv:2004.13336, see PAPERS.md) for this
+framework's data-parallel step: instead of every replica redundantly holding
+optimizer state and applying the full weight update,
+
+- gradients are ``psum_scatter``'d (reduce-scatter) over the 'data' axis —
+  each replica receives the averaged gradient for its 1/n slice of the
+  flattened parameter vector;
+- the optimizer update (any optax transform, including this framework's
+  reference-exact SGD/Adam) runs on that slice only — optimizer memory and
+  update FLOPs drop by n;
+- updated slices are ``all_gather``'d back into full replicated parameters.
+
+Communication volume equals the plain allreduce (reduce-scatter + all-gather
+IS the ring allreduce, split around the update), so the step pays nothing on
+the wire. K-of-N participation masks work unchanged: contributions are
+weighted before the scatter and the all-zero-mask no-op guard applies to the
+slice update.
+
+The reference system has no equivalent — its optimizer state lived solely on
+the master (``optim/sgd.py:80-90``); this is the TPU-idiomatic scale-out of
+exactly that idea: every replica is "the master" for 1/n of the model.
+"""
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.flatten_util import ravel_pytree
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ps_pytorch_tpu.parallel.dp import (
+    TrainState, _model_collections, apply_optimizer, make_loss_fn,
+    masked_metrics,
+)
+
+
+def _flat_size_and_unravel(params):
+    flat, unravel = ravel_pytree(params)
+    return flat.size, flat, unravel
+
+
+def create_zero_train_state(model, tx: optax.GradientTransformation,
+                            mesh: Mesh, sample_shape, rng) -> TrainState:
+    """TrainState whose opt_state is built on per-replica parameter slices:
+    leaves carry a leading [n_data] axis sharded over 'data' (scalar leaves,
+    e.g. step counters, stay replicated)."""
+    n = mesh.shape["data"]
+
+    def init_fn(rng):
+        params, batch_stats = _model_collections(model, sample_shape, rng)
+        size, flat, _ = _flat_size_and_unravel(params)
+        chunk = -(-size // n)
+        shard0 = jnp.zeros((chunk,), flat.dtype)
+        opt_shard = tx.init(shard0)
+        # Stack n copies: correct for zero-init buffers and replicated
+        # scalars alike (every optax state we use inits to zeros/constants).
+        opt_state = jax.tree.map(
+            lambda a: jnp.tile(a[None], (n,) + (1,) * a.ndim)
+            if a.ndim >= 1 else a, opt_shard)
+        batch_stats = jax.tree.map(
+            lambda a: jnp.tile(a[None], (n,) + (1,) * a.ndim), batch_stats)
+        return TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                          opt_state=opt_state, batch_stats=batch_stats)
+
+    from ps_pytorch_tpu.parallel.dp import state_shardings
+    shapes = jax.eval_shape(init_fn, rng)
+    shardings = state_shardings(mesh, shapes, zero_state_specs(shapes))
+    return jax.jit(init_fn, out_shardings=shardings)(rng)
+
+
+def zero_state_specs(state: TrainState) -> TrainState:
+    return TrainState(
+        step=P(),
+        params=jax.tree.map(lambda _: P(), state.params),
+        opt_state=jax.tree.map(
+            lambda a: P("data") if a.ndim >= 1 else P(), state.opt_state),
+        batch_stats=jax.tree.map(lambda _: P("data"), state.batch_stats),
+    )
+
+
+def make_zero_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
+                         state: TrainState, *, sync_batchnorm: bool = False,
+                         remat: bool = False, donate: bool = True) -> Callable:
+    """Same signature/semantics as ``dp.make_train_step`` with the weight
+    update sharded across the 'data' axis."""
+    has_bn = bool(jax.tree.leaves(state.batch_stats))
+    n = mesh.shape["data"]
+    loss_fn = make_loss_fn(model, has_bn)
+    vg = jax.value_and_grad(
+        jax.checkpoint(loss_fn) if remat else loss_fn, has_aux=True)
+
+    def local_step(state, x, y, mask, rng):
+        bs_local = jax.tree.map(lambda a: a[0], state.batch_stats)
+        opt_local = jax.tree.map(
+            lambda a: a[0] if a.ndim >= 1 else a, state.opt_state)
+        rng = jax.random.fold_in(rng, jax.lax.axis_index("data"))
+        (loss, (new_bs, acc)), grads = vg(state.params, bs_local, x, y, rng)
+        m = mask[0]
+        msum = jax.lax.psum(m, "data")
+        denom = jnp.maximum(msum, 1.0)
+
+        # Reduce-scatter the masked gradient: replica i receives the summed
+        # slice [i*chunk, (i+1)*chunk) of the flattened gradient.
+        size, gflat, _ = _flat_size_and_unravel(grads)
+        chunk = -(-size // n)
+        gflat = jnp.pad(gflat * m, (0, chunk * n - size))
+        gshard = jax.lax.psum_scatter(gflat, "data", tiled=True) / denom
+
+        # This replica's parameter slice.
+        _, pflat, unravel = _flat_size_and_unravel(state.params)
+        pflat = jnp.pad(pflat, (0, chunk * n - size))
+        idx = jax.lax.axis_index("data")
+        pshard = jax.lax.dynamic_slice(pflat, (idx * chunk,), (chunk,))
+
+        # Works for optax transforms and the fused Pallas kernel alike (the
+        # slice is just a 1-leaf pytree to either).
+        new_pshard, new_opt = apply_optimizer(tx, pshard, opt_local, gshard)
+
+        stepped = msum > 0
+        new_pshard = jnp.where(stepped, new_pshard, pshard)
+        new_opt = jax.tree.map(
+            lambda new, old: jnp.where(stepped, new, old), new_opt, opt_local)
+
+        # Gather updated slices back into the full replicated vector.
+        new_pflat = jax.lax.all_gather(new_pshard, "data", tiled=True)
+        new_params = unravel(new_pflat[:size])
+
+        if has_bn and sync_batchnorm:
+            new_bs = jax.tree.map(
+                lambda a: jax.lax.psum(a * m, "data") / denom, new_bs)
+        metrics = masked_metrics(loss, acc, m, denom, msum)
+        new_state = state.replace(
+            step=state.step + 1, params=new_params,
+            opt_state=jax.tree.map(
+                lambda new, old: new[None] if old.ndim >= 1 else new,
+                new_opt, opt_local),
+            batch_stats=jax.tree.map(lambda a: a[None], new_bs))
+        return new_state, metrics
+
+    specs = zero_state_specs(state)
+    sharded = jax.shard_map(
+        local_step, mesh=mesh,
+        in_specs=(specs, P("data"), P("data"), P("data"), P()),
+        out_specs=(specs, P()),
+        check_vma=False)
+    return jax.jit(sharded, donate_argnums=(0,) if donate else ())

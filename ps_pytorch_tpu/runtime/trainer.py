@@ -51,11 +51,26 @@ class Trainer:
         self.train_loader, self.test_loader = prepare_data(
             cfg, host_id=host_id, num_hosts=num_hosts, download=download)
         sample = (1,) + sample_shape(cfg.dataset)
-        self.state = create_train_state(self.model, self.tx, self.mesh, sample,
-                                        jax.random.key(cfg.seed))
-        self.step_fn = make_train_step(self.model, self.tx, self.mesh, self.state,
-                                       sync_batchnorm=cfg.sync_batchnorm,
-                                       remat=cfg.remat, donate=cfg.donate)
+        if cfg.shard_update:
+            from ps_pytorch_tpu.parallel.zero import (
+                create_zero_train_state, make_zero_train_step, zero_state_specs,
+            )
+            self.state = create_zero_train_state(
+                self.model, self.tx, self.mesh, sample, jax.random.key(cfg.seed))
+            self.step_fn = make_zero_train_step(
+                self.model, self.tx, self.mesh, self.state,
+                sync_batchnorm=cfg.sync_batchnorm, remat=cfg.remat,
+                donate=cfg.donate)
+            self._state_specs = zero_state_specs
+        else:
+            self.state = create_train_state(self.model, self.tx, self.mesh,
+                                            sample, jax.random.key(cfg.seed))
+            self.step_fn = make_train_step(self.model, self.tx, self.mesh,
+                                           self.state,
+                                           sync_batchnorm=cfg.sync_batchnorm,
+                                           remat=cfg.remat, donate=cfg.donate)
+            from ps_pytorch_tpu.parallel.dp import state_specs
+            self._state_specs = state_specs
         self.eval_fn = make_eval_step(self.model)
         if coordinator is None:
             kv = None
@@ -73,6 +88,13 @@ class Trainer:
             i for i, row in enumerate(self.mesh.devices)
             if row.flat[0].process_index == jax.process_index()]
         self.metrics = MetricsLogger(cfg.metrics_file, cfg.log_every)
+        # jax.profiler trace window (SURVEY §5.1: the reference's hand-rolled
+        # timers + our structured lines, plus real profiler integration).
+        self._profile_range = None
+        self._trace_active = False
+        if cfg.profile_dir:
+            lo, _, hi = cfg.profile_steps.partition("-")
+            self._profile_range = (int(lo), int(hi or lo))
         self.start_step = 0
         if cfg.resume:
             self._maybe_resume()
@@ -86,7 +108,7 @@ class Trainer:
         template = fetch_replicated(self.mesh, self.state) \
             if dist.is_multiprocess() else self.state
         state, meta, _ = ckpt.load_checkpoint(self.cfg.train_dir, step, template)
-        self.state = place_state(self.mesh, state)
+        self.state = place_state(self.mesh, state, self._state_specs(state))
         self.start_step = int(meta["step"])
         print(f"RESUME from {ckpt.checkpoint_path(self.cfg.train_dir, step)} "
               f"at step {self.start_step}")
@@ -119,6 +141,17 @@ class Trainer:
         step = self.start_step
         while step < last_step:
             step += 1
+            if self._profile_range:
+                lo, hi = self._profile_range
+                # Window-membership, not step equality: a resumed run may
+                # enter the loop past `lo` (or never reach `hi`).
+                if not self._trace_active and lo <= step <= hi:
+                    jax.profiler.start_trace(self.cfg.profile_dir)
+                    self._trace_active = True
+                elif self._trace_active and step > hi:
+                    jax.profiler.stop_trace()
+                    self._trace_active = False
+                    self._profile_range = None
             self.coordinator.announce_step(step)
             t0 = time.monotonic()
             x, y = self.train_loader.next_batch()
@@ -149,6 +182,9 @@ class Trainer:
             if cfg.eval_freq > 0 and step % cfg.eval_freq == 0:
                 self._checkpoint(step)
         jax.block_until_ready(self.state.params)
+        if self._trace_active:
+            jax.profiler.stop_trace()  # run ended inside the trace window
+            self._trace_active = False
         if cfg.eval_freq > 0 and step % cfg.eval_freq != 0:
             self._checkpoint(step)
         self.metrics.close()

@@ -88,3 +88,33 @@ def test_validates():
     agg = StaleGradientAggregator(2)
     with pytest.raises(ValueError):
         agg.submit(5, step=1, grads=_g(1.0))
+
+
+def test_int8_codec_roundtrip_aggregation(rng):
+    """DCN aggregation with the on-device int8 codec: ~4x wire shrink, small
+    unbiased error on the averaged gradient."""
+    import jax
+    import numpy as np
+    from ps_pytorch_tpu.parallel.async_dp import StaleGradientAggregator
+
+    agg = StaleGradientAggregator(n_slices=2, staleness_limit=2,
+                                  compress=True, codec="int8")
+    g0 = {"w": rng.normal(size=(256, 128)).astype(np.float32)}
+    g1 = {"w": rng.normal(size=(256, 128)).astype(np.float32)}
+    agg.submit(0, step=5, grads=g0)
+    agg.submit(1, step=5, grads=g1)
+    raw_bytes = g0["w"].nbytes + g1["w"].nbytes
+    assert agg.wire_bytes() < raw_bytes / 3.5
+    avg, info = agg.collect(current_step=5)
+    assert info["used"] == [0, 1]
+    want = (g0["w"] + g1["w"]) / 2
+    quantum = max(np.abs(g0["w"]).max(), np.abs(g1["w"]).max()) / 127.0
+    assert np.max(np.abs(np.asarray(avg["w"]) - want)) <= quantum + 1e-6
+
+
+def test_unknown_codec_rejected():
+    import pytest
+    from ps_pytorch_tpu.parallel.async_dp import StaleGradientAggregator
+
+    with pytest.raises(ValueError):
+        StaleGradientAggregator(n_slices=1, codec="zstd")
