@@ -155,14 +155,20 @@ def main(argv=None) -> int:
         name = name.strip()
         if name not in CONFIGS:
             raise SystemExit(f"unknown config {name!r}; have {sorted(CONFIGS)}")
-        r = CONFIGS[name](args.steps)
-        print(json.dumps(r))
+        try:
+            r = CONFIGS[name](args.steps)
+        except Exception as e:  # one config failing must not lose the rest
+            r = {"config": name, "error": f"{type(e).__name__}: {e}"[:300]}
+        print(json.dumps(r), flush=True)
         rows.append(r)
 
     if args.markdown:
         lines = ["| config | devices | global batch | sec/step | images/sec | vs baseline |",
                  "|---|---|---|---|---|---|"]
         for r in rows:
+            if "error" in r:
+                lines.append(f"| {r['config']} | — | — | — | — | ERROR: {r['error'][:60]} |")
+                continue
             if "images_per_sec" not in r:
                 lines.append(f"| {r['config']} | — | {r.get('steps','—')} steps "
                              f"| {r['seconds']} s total | — | converged={r['converged']} |")

@@ -56,6 +56,8 @@ class TrainConfig:
     kill_threshold: float = 0.0      # straggler deadline in seconds; 0 = no deadline
     staleness_limit: int = 4         # async mode: drop contributions older than this many steps
     staleness_decay: float = 0.0     # async mode: weight = decay**staleness; 0 = no decay (pure average)
+    async_slices: int = 2            # async mode: device groups acting as independent slices
+    fetch_every: int = 1             # async mode: slice re-fetches canonical weights every N of its steps
     data_axis: int = 0               # number of data-parallel shards; 0 = all local devices
     model_axis: int = 1              # reserved mesh axis for TP (unused by these models)
     sync_batchnorm: bool = False     # reference keeps BN stats worker-local (distributed_worker.py:245-252)
@@ -70,6 +72,7 @@ class TrainConfig:
     # -- compression (reference: --compress-grad, compression.py) --
     compress_grad: bool = False      # compress DCN-crossing gradient mirrors / checkpoints
     codec_level: int = 3
+    grad_codec: str = "blosc"        # blosc (lossless, native C++) | int8 (on-device Pallas)
 
     # -- logging / profiling --
     log_every: int = 1
@@ -85,6 +88,8 @@ class TrainConfig:
             self.num_classes = DATASET_SHAPES.get(self.dataset, (0, 0, 0, 10, 0))[3]
         if self.mode not in ("sync", "kofn", "async"):
             raise ValueError(f"unknown mode {self.mode!r}")
+        if self.grad_codec not in ("blosc", "int8"):
+            raise ValueError(f"unknown grad_codec {self.grad_codec!r} (blosc | int8)")
         if self.nesterov and (self.momentum <= 0):
             raise ValueError("Nesterov momentum requires a momentum")
 

@@ -131,9 +131,20 @@ class StaleGradientAggregator:
                 "dropped_stale": dropped, "weights": weights}
         return jax.tree.unflatten(treedef_out, avg), info
 
-    def drop_older_than(self, current_step: int) -> None:
-        """GC the pool (contributions that can never be used again)."""
+    def consume(self, slice_ids) -> None:
+        """Remove applied contributions (a gradient counts once — the
+        reference master resets its accumulator each step,
+        ``sync_replicas_master_nn.py:77-93``)."""
+        for sid in slice_ids:
+            self._pool.pop(sid, None)
+
+    def drop_older_than(self, current_step: int) -> int:
+        """GC the pool (contributions that can never be used again).
+        Returns how many were removed — the authoritative dropped-stale
+        count (collect() reports but does not remove, so its list would
+        double-count across ticks)."""
         dead = [sid for sid, (step, _, _) in self._pool.items()
                 if current_step - step > self.limit]
         for sid in dead:
             del self._pool[sid]
+        return len(dead)

@@ -27,6 +27,23 @@ from ps_pytorch_tpu.runtime import checkpoint as ckpt
 EVAL_LINE = "EVAL step {step} loss {loss:.6f} prec1 {prec1:.4f} prec5 {prec5:.4f}"
 
 
+def accumulate_eval(eval_fn, params, bstats, batches, max_batches=None) -> dict:
+    """Shared eval accumulation (trainer/multislice/evaluator): run
+    ``eval_fn(params, bstats, x, y)`` over ``batches`` and reduce to
+    loss / prec1 / prec5 / count."""
+    tot = {"sum_loss": 0.0, "top1": 0, "top5": 0, "count": 0}
+    for i, (x, y) in enumerate(batches):
+        if max_batches is not None and i >= max_batches:
+            break
+        m = eval_fn(params, bstats, jnp.asarray(x), jnp.asarray(y))
+        tot["sum_loss"] += float(m["sum_loss"])
+        for k in ("top1", "top5", "count"):
+            tot[k] += int(m[k])
+    n = max(tot["count"], 1)
+    return {"loss": tot["sum_loss"] / n, "prec1": tot["top1"] / n,
+            "prec5": tot["top5"] / n, "count": tot["count"]}
+
+
 class Evaluator:
     def __init__(self, train_dir: str, poll_s: float = 10.0,
                  printer: Callable = print, download: bool = False):
@@ -57,17 +74,11 @@ class Evaluator:
         if config_json != self._built_for:
             self._build(config_json)
         state, meta, _ = ckpt.load_checkpoint(self.train_dir, step, self.template)
-        params = state.params
-        bstats = replica0_batch_stats(state)
-        tot = {"sum_loss": 0.0, "top1": 0, "top5": 0, "count": 0}
-        for x, y in self.test_loader.epoch(0):
-            m = self.eval_fn(params, bstats, jnp.asarray(x), jnp.asarray(y))
-            tot["sum_loss"] += float(m["sum_loss"])
-            for k in ("top1", "top5", "count"):
-                tot[k] += int(m[k])
-        n = max(tot["count"], 1)
-        result = {"step": step, "loss": tot["sum_loss"] / n,
-                  "prec1": tot["top1"] / n, "prec5": tot["top5"] / n}
+        result = accumulate_eval(self.eval_fn, state.params,
+                                 replica0_batch_stats(state),
+                                 self.test_loader.epoch(0))
+        result = {"step": step, "loss": result["loss"],
+                  "prec1": result["prec1"], "prec5": result["prec5"]}
         self.printer(EVAL_LINE.format(**result))
         return result
 

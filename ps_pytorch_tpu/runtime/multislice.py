@@ -1,0 +1,235 @@
+"""Multi-slice asynchronous (stale-gradient) training.
+
+The end-to-end home of the reference's async mode (SURVEY §2.5 row 2;
+BASELINE.json config 4: VGG-11 / CIFAR-100, async/stale-gradient): within a
+slice SPMD is inherently synchronous, so asynchrony lives BETWEEN slices —
+each slice computes an in-graph psum-averaged gradient against the parameter
+version it last fetched (possibly stale), ships it to the aggregator tagged
+with that version's step (``parallel/async_dp.py`` — the explicit-metadata
+re-expression of the reference's ``step*1000 + tag`` staleness encoding,
+``resnet_split.py:25-42``), and the canonical parameters advance from
+whatever fresh-enough contributions exist: PS semantics with the "master"
+reduced to an optimizer over a gradient pool.
+
+Here the slices are device subsets of one process (how a single host hosts
+the CI rig and how a v4 pod slice would partition); across real DCN the same
+object runs per-slice with the aggregator behind the coordination-service KV
+or a gRPC shim, contributions optionally codec-compressed (blosc or the
+on-device int8 quantizer) exactly as they would travel.
+
+Scheduling model (deterministic, testable): slice i advances every
+``slice_periods[i]`` global ticks and re-fetches canonical params every
+``fetch_every`` of its own steps — a slow slice therefore submits gradients
+computed on stale weights, exercising drop/decay paths without wall-clock
+nondeterminism.
+"""
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ps_pytorch_tpu.config import TrainConfig
+from ps_pytorch_tpu.data import prepare_data
+from ps_pytorch_tpu.data.datasets import sample_shape
+from ps_pytorch_tpu.models import build_model
+from ps_pytorch_tpu.optim import build_optimizer
+from ps_pytorch_tpu.parallel.async_dp import StaleGradientAggregator
+from ps_pytorch_tpu.parallel.dp import make_loss_fn, apply_optimizer
+from ps_pytorch_tpu.parallel.mesh import make_mesh
+from ps_pytorch_tpu.runtime.metrics import MetricsLogger
+
+
+def make_slice_grad_fn(model, mesh: Mesh, has_bn: bool):
+    """Jitted per-slice gradient: (params, bs, x, y, rng) ->
+    (psum-averaged grads, metrics, new_bs). Params replicated within the
+    slice; batch sharded over its 'data' axis."""
+    loss_fn = make_loss_fn(model, has_bn)
+    vg = jax.value_and_grad(loss_fn, has_aux=True)
+
+    def local(params, bs, x, y, rng):
+        bs_local = jax.tree.map(lambda a: a[0], bs)
+        rng = jax.random.fold_in(rng, jax.lax.axis_index("data"))
+        (loss, (new_bs, acc)), grads = vg(params, bs_local, x, y, rng)
+        n = jax.lax.axis_size("data")
+        grads = jax.tree.map(lambda g: jax.lax.psum(g, "data") / n, grads)
+        loss = jax.lax.psum(loss, "data") / n
+        acc = jax.lax.psum(acc, "data") / n
+        return grads, {"loss": loss, "accuracy": acc}, \
+            jax.tree.map(lambda a: a[None], new_bs)
+
+    bs_spec = P("data")
+    sharded = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P(), bs_spec, P("data"), P("data"), P()),
+        out_specs=(P(), P(), bs_spec),
+        check_vma=False)
+    return jax.jit(sharded)
+
+
+class MultiSliceTrainer:
+    """PS-style asynchronous training over ``n_slices`` device groups."""
+
+    def __init__(self, cfg: TrainConfig, n_slices: int = 2,
+                 slice_periods: Optional[Sequence[int]] = None,
+                 fetch_every: int = 1, devices: Optional[List] = None):
+        devices = list(devices if devices is not None else jax.devices())
+        if len(devices) % n_slices:
+            raise ValueError(f"{len(devices)} devices not divisible by "
+                             f"{n_slices} slices")
+        per = len(devices) // n_slices
+        self.cfg = cfg
+        self.n_slices = n_slices
+        self.slice_periods = list(slice_periods or [1] * n_slices)
+        if len(self.slice_periods) != n_slices:
+            raise ValueError("need one period per slice")
+        self.fetch_every = max(fetch_every, 1)
+        self.meshes = [make_mesh(data=per, devices=devices[i * per:(i + 1) * per])
+                       for i in range(n_slices)]
+        self.model = build_model(cfg.network, cfg.num_classes, cfg.compute_dtype)
+        self.tx = build_optimizer(cfg)
+
+        shape = (1,) + sample_shape(cfg.dataset)
+        variables = self.model.init(jax.random.key(cfg.seed),
+                                    jnp.zeros(shape, jnp.float32), train=False)
+        self.params = jax.device_get(variables["params"])
+        self.opt_state = jax.device_get(self.tx.init(variables["params"]))
+        self.has_bn = "batch_stats" in variables
+        bs0 = variables.get("batch_stats", {})
+        # Per-slice replica-local BN stats (reference keeps BN per worker).
+        self._bs = [jax.device_get(jax.tree.map(
+            lambda a: np.tile(a[None], (per,) + (1,) * a.ndim), bs0))
+            for _ in range(n_slices)]
+
+        self.aggregator = StaleGradientAggregator(
+            n_slices, staleness_limit=cfg.staleness_limit,
+            staleness_decay=cfg.staleness_decay,
+            num_aggregate=cfg.num_aggregate, compress=cfg.compress_grad,
+            codec=cfg.grad_codec, codec_level=cfg.codec_level)
+        self.grad_fns = [make_slice_grad_fn(self.model, m, self.has_bn)
+                         for m in self.meshes]
+        # Each slice's last-fetched parameter copy and its version step.
+        self._slice_params = [self.params] * n_slices
+        self._slice_version = [0] * n_slices
+        self._slice_steps = [0] * n_slices
+        # One jitted canonical update (host-side PS role).
+        self._update = jax.jit(
+            lambda p, o, g: apply_optimizer(self.tx, p, o, g))
+
+        self.train_loader, self.test_loader = prepare_data(cfg)
+        self.metrics = MetricsLogger(cfg.metrics_file, cfg.log_every)
+        self.step = 0          # canonical (master) step
+        self.applied = 0       # updates actually applied
+        self.dropped_stale = 0
+
+    def _slice_batch(self, s: int):
+        x, y = self.train_loader.next_batch()
+        # Each slice trains on its own stream position (the loader shuffles
+        # per epoch; slices just consume successive batches).
+        return jnp.asarray(x), jnp.asarray(y)
+
+    def tick(self) -> dict:
+        """One global tick: scheduled slices compute+submit; the canonical
+        params advance from the pool. Returns tick metrics."""
+        self.step += 1
+        info = {"computed": [], "loss": None, "acc": None}
+        losses, accs = [], []
+        for s in range(self.n_slices):
+            if (self.step - 1) % self.slice_periods[s]:
+                continue
+            # Re-fetch canonical weights every fetch_every slice-steps.
+            if self._slice_steps[s] % self.fetch_every == 0:
+                self._slice_params[s] = self.params
+                self._slice_version[s] = self.step - 1
+            self._slice_steps[s] += 1
+            x, y = self._slice_batch(s)
+            grads, m, new_bs = self.grad_fns[s](
+                self._slice_params[s], self._bs[s], x, y,
+                jax.random.PRNGKey(self.cfg.seed * 7919 + self.step * 13 + s))
+            self._bs[s] = new_bs
+            self.aggregator.submit(s, self._slice_version[s],
+                                   jax.device_get(grads))
+            info["computed"].append(s)
+            losses.append(float(m["loss"]))
+            accs.append(float(m["accuracy"]))
+        if losses:
+            info["loss"] = sum(losses) / len(losses)
+            info["acc"] = sum(accs) / len(accs)
+        avg, pool = self.aggregator.collect(self.step - 1)
+        if avg is not None and pool["used"]:
+            self.params, self.opt_state = self._update(
+                self.params, self.opt_state, avg)
+            self.applied += 1
+            self.aggregator.consume(pool["used"])
+        # GC every tick (collect only reports; unremoved entries would be
+        # re-counted next tick and retain dead gradients).
+        self.dropped_stale += self.aggregator.drop_older_than(self.step - 1)
+        info["used"] = pool["used"]
+        return info
+
+    def evaluate(self, max_batches: Optional[int] = None) -> dict:
+        """Top-1/top-5/loss on canonical params (slice-0 BN stats, matching
+        the reference evaluator consuming one worker's checkpoint)."""
+        from ps_pytorch_tpu.parallel.dp import make_eval_step
+        from ps_pytorch_tpu.runtime.evaluator import accumulate_eval
+        return accumulate_eval(make_eval_step(self.model), self.params,
+                               jax.tree.map(lambda a: a[0], self._bs[0]),
+                               self.test_loader.epoch(0), max_batches)
+
+    # ---- checkpoint/resume (same contract + format as the sync Trainer) ----
+    def _as_train_state(self):
+        from ps_pytorch_tpu.parallel.dp import TrainState
+        return TrainState(step=jnp.asarray(self.step, jnp.int32),
+                          params=self.params, opt_state=self.opt_state,
+                          batch_stats=self._bs[0])
+
+    def _checkpoint(self) -> None:
+        from ps_pytorch_tpu.runtime import checkpoint as ckpt
+        ckpt.save_checkpoint(self.cfg.train_dir, self.step,
+                             jax.device_get(self._as_train_state()),
+                             config_json=self.cfg.to_json(),
+                             compress=self.cfg.compress_grad,
+                             codec_level=self.cfg.codec_level)
+
+    def maybe_resume(self) -> bool:
+        """Restore canonical params/opt state (and slice-0 BN stats; other
+        slices keep fresh stats, like freshly relaunched reference workers)."""
+        from ps_pytorch_tpu.runtime import checkpoint as ckpt
+        step = ckpt.latest_step(self.cfg.train_dir)
+        if step is None:
+            return False
+        state, meta, _ = ckpt.load_checkpoint(
+            self.cfg.train_dir, step, jax.device_get(self._as_train_state()))
+        self.params, self.opt_state = state.params, state.opt_state
+        self._bs[0] = state.batch_stats
+        self.step = int(meta["step"])
+        self._slice_params = [self.params] * self.n_slices
+        self._slice_version = [self.step] * self.n_slices
+        print(f"RESUME from {ckpt.checkpoint_path(self.cfg.train_dir, step)} "
+              f"at step {self.step}")
+        return True
+
+    def train(self, max_steps: Optional[int] = None):
+        cfg = self.cfg
+        if cfg.resume:
+            self.maybe_resume()
+        last = max_steps or cfg.max_steps
+        import time
+        while self.step < last:
+            t0 = time.monotonic()
+            info = self.tick()
+            if info["loss"] is not None and self.step % cfg.log_every == 0:
+                self.metrics.log_step(
+                    self.step, 0, loss=info["loss"], acc=info["acc"],
+                    participating=float(len(info["used"])),
+                    step_time=time.monotonic() - t0, data_time=0.0,
+                    applied=self.applied, dropped_stale=self.dropped_stale)
+            if cfg.eval_freq > 0 and self.step % cfg.eval_freq == 0:
+                self._checkpoint()
+        if cfg.eval_freq > 0 and self.step % cfg.eval_freq != 0:
+            self._checkpoint()
+        self.metrics.close()
+        return self.params

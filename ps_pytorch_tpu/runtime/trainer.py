@@ -202,13 +202,6 @@ class Trainer:
         else:
             params = self.state.params
             bstats = replica0_batch_stats(self.state)
-        tot = {"sum_loss": 0.0, "top1": 0, "top5": 0, "count": 0}
-        for i, (x, y) in enumerate(self.test_loader.epoch(0)):
-            if max_batches is not None and i >= max_batches:
-                break
-            m = self.eval_fn(params, bstats, jnp.asarray(x), jnp.asarray(y))
-            for k in tot:
-                tot[k] += float(m[k]) if k == "sum_loss" else int(m[k])
-        n = max(tot["count"], 1)
-        return {"loss": tot["sum_loss"] / n, "prec1": tot["top1"] / n,
-                "prec5": tot["top5"] / n, "count": tot["count"]}
+        from ps_pytorch_tpu.runtime.evaluator import accumulate_eval
+        return accumulate_eval(self.eval_fn, params, bstats,
+                               self.test_loader.epoch(0), max_batches)

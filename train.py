@@ -30,9 +30,24 @@ def main(argv=None) -> int:
               f"local_devices={jax.local_device_count()}")
     cfg = config_from_args(argv)
     print(f"CONFIG {cfg.to_json()}")
-    trainer = Trainer(cfg)
-    print(f"MESH data={trainer.mesh.shape['data']} model={trainer.mesh.shape['model']} "
-          f"devices={len(trainer.mesh.devices.flat)}")
+    if cfg.mode == "async":
+        # Multi-slice stale-gradient training (the reference's async mode):
+        # device groups act as independent slices feeding the aggregator.
+        import jax
+        if jax.process_count() > 1:
+            raise SystemExit(
+                "--mode async is single-process (slices are device groups of "
+                "one host); run it per pod-slice, with cross-slice "
+                "aggregation over your DCN transport (parallel/async_dp.py)")
+        from ps_pytorch_tpu.runtime.multislice import MultiSliceTrainer
+        trainer = MultiSliceTrainer(cfg, n_slices=cfg.async_slices,
+                                    fetch_every=cfg.fetch_every)
+        print(f"SLICES {cfg.async_slices} x "
+              f"{len(trainer.meshes[0].devices.flat)} devices")
+    else:
+        trainer = Trainer(cfg)
+        print(f"MESH data={trainer.mesh.shape['data']} model={trainer.mesh.shape['model']} "
+              f"devices={len(trainer.mesh.devices.flat)}")
     trainer.train()
     result = trainer.evaluate()
     print(f"FINAL loss {result['loss']:.6f} prec1 {result['prec1']:.4f} "
