@@ -15,4 +15,5 @@ CPU mesh while real runs compile to Mosaic.
 from ps_pytorch_tpu.ops.quantize import (  # noqa: F401
     dequantize_int8, quantize_int8, quantized_nbytes,
 )
-from ps_pytorch_tpu.ops.fused_sgd import fused_sgd_step  # noqa: F401
+from ps_pytorch_tpu.ops.fused_sgd import FusedSGD, fused_sgd_step  # noqa: F401
+from ps_pytorch_tpu.ops.fused_adam import FusedAdam  # noqa: F401

@@ -1,0 +1,133 @@
+"""Fused Adam / AMSGrad parameter update (Pallas).
+
+Companion to ``ops/fused_sgd.py``: one kernel per parameter buffer performs
+the reference's exact Adam update (``optim/adam.py:38-94``: weight-decay
+fold, biased first/second moments, optional AMSGrad max, torch-style eps
+OUTSIDE the sqrt, bias-corrected step size) in a single HBM read+write pass
+with params and both moment buffers aliased in place. The bias-correction
+scalar is computed host-side per step and fed through SMEM.
+
+Off-TPU the kernel runs in Pallas interpreter mode; golden tests assert
+agreement with ``optim.adam`` (itself a golden transcription of the
+reference's torch fork).
+"""
+
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ps_pytorch_tpu.optim.adam import AdamState
+from ps_pytorch_tpu.ops.fused_sgd import LANES, BLOCK_ROWS, _interpret_default, _pad2d
+
+
+def _make_kernel(b1: float, b2: float, eps: float, weight_decay: float,
+                 amsgrad: bool):
+    if amsgrad:
+        def kernel(ss_ref, p_ref, m_ref, v_ref, vh_ref, g_ref,
+                   p_out, m_out, v_out, vh_out):
+            step_size = ss_ref[0, 0]
+            p = p_ref[:]
+            g = g_ref[:]
+            if weight_decay != 0.0:
+                g = g + weight_decay * p
+            m = b1 * m_ref[:] + (1.0 - b1) * g
+            v = b2 * v_ref[:] + (1.0 - b2) * g * g
+            vh = jnp.maximum(vh_ref[:], v)
+            p_out[:] = p - step_size * m / (jnp.sqrt(vh) + eps)
+            m_out[:] = m
+            v_out[:] = v
+            vh_out[:] = vh
+    else:
+        def kernel(ss_ref, p_ref, m_ref, v_ref, g_ref, p_out, m_out, v_out):
+            step_size = ss_ref[0, 0]
+            p = p_ref[:]
+            g = g_ref[:]
+            if weight_decay != 0.0:
+                g = g + weight_decay * p
+            m = b1 * m_ref[:] + (1.0 - b1) * g
+            v = b2 * v_ref[:] + (1.0 - b2) * g * g
+            p_out[:] = p - step_size * m / (jnp.sqrt(v) + eps)
+            m_out[:] = m
+            v_out[:] = v
+    return kernel
+
+
+@partial(jax.jit, static_argnames=("b1", "b2", "eps", "weight_decay",
+                                   "amsgrad", "interpret"))
+def _fused_update_padded(bufs, step_size, *, b1, b2, eps, weight_decay,
+                         amsgrad, interpret):
+    # bufs: (p2d, m2d, v2d[, vh2d], g2d) all [R, 128] float32.
+    nblk = bufs[0].shape[0] // BLOCK_ROWS
+    vspec = pl.BlockSpec((BLOCK_ROWS, LANES), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM)
+    sspec = pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM)
+    n_out = len(bufs) - 1            # every state buffer except g is updated
+    shape = jax.ShapeDtypeStruct(bufs[0].shape, jnp.float32)
+    return pl.pallas_call(
+        _make_kernel(b1, b2, eps, weight_decay, amsgrad),
+        grid=(nblk,),
+        in_specs=[sspec] + [vspec] * len(bufs),
+        out_specs=[vspec] * n_out,
+        out_shape=[shape] * n_out,
+        # p, m, v(, vh) update in place; operand 0 is step_size, g is last.
+        input_output_aliases={i + 1: i for i in range(n_out)},
+        interpret=interpret,
+    )(jnp.reshape(step_size.astype(jnp.float32), (1, 1)), *bufs)
+
+
+class FusedAdam:
+    """Drop-in fused optimizer (same ``init`` contract as ``optim.adam``);
+    dispatched by the train steps via its ``apply`` method."""
+
+    def __init__(self, lr, b1: float = 0.9, b2: float = 0.999,
+                 eps: float = 1e-8, weight_decay: float = 0.0,
+                 amsgrad: bool = False, interpret: Optional[bool] = None):
+        self.lr = lr
+        self.b1, self.b2, self.eps = b1, b2, eps
+        self.weight_decay = weight_decay
+        self.amsgrad = amsgrad
+        self.interpret = interpret
+
+    def init(self, params) -> AdamState:
+        z = lambda: jax.tree.map(jnp.zeros_like, params)
+        return AdamState(step=jnp.zeros((), jnp.int32), exp_avg=z(),
+                         exp_avg_sq=z(),
+                         max_exp_avg_sq=z() if self.amsgrad else ())
+
+    def apply(self, params: Any, state: AdamState, grads: Any):
+        interpret = self.interpret
+        if interpret is None:
+            interpret = _interpret_default()
+        t = state.step + 1
+        tf = t.astype(jnp.float32)
+        lr_t = self.lr(state.step) if callable(self.lr) else self.lr
+        step_size = lr_t * jnp.sqrt(1 - self.b2 ** tf) / (1 - self.b1 ** tf)
+
+        def leaf(p, m, v, vh, g):
+            p2d, _ = _pad2d(p)
+            bufs = [p2d, _pad2d(m)[0], _pad2d(v)[0]]
+            if self.amsgrad:
+                bufs.append(_pad2d(vh)[0])
+            bufs.append(_pad2d(g)[0])
+            outs = _fused_update_padded(
+                tuple(bufs), step_size, b1=self.b1, b2=self.b2, eps=self.eps,
+                weight_decay=self.weight_decay, amsgrad=self.amsgrad,
+                interpret=interpret)
+            unflat = lambda a2d: a2d.reshape(-1)[:p.size].reshape(p.shape).astype(p.dtype)
+            outs = [unflat(o) for o in outs]
+            return tuple(outs) if self.amsgrad else (outs[0], outs[1], outs[2], ())
+
+        # Placeholder leaves (not empty containers — tree structures must
+        # match) when AMSGrad is off; `leaf` never reads them.
+        vh_in = state.max_exp_avg_sq if self.amsgrad \
+            else jax.tree.map(lambda _: 0.0, params)
+        out = jax.tree.map(leaf, params, state.exp_avg, state.exp_avg_sq,
+                           vh_in, grads)
+        is_res = lambda x: isinstance(x, tuple) and len(x) == 4
+        pick = lambda i: jax.tree.map(lambda r: r[i], out, is_leaf=is_res)
+        return pick(0), AdamState(step=t, exp_avg=pick(1), exp_avg_sq=pick(2),
+                                  max_exp_avg_sq=pick(3) if self.amsgrad else ())

@@ -23,6 +23,11 @@ def build_optimizer(cfg):
         return sgd(lr=cfg.lr, momentum=cfg.momentum,
                    weight_decay=cfg.weight_decay, nesterov=cfg.nesterov)
     if cfg.optimizer == "adam":
+        if getattr(cfg, "fused_optimizer", False):
+            from ps_pytorch_tpu.ops.fused_adam import FusedAdam
+            return FusedAdam(lr=cfg.lr, b1=cfg.adam_beta1, b2=cfg.adam_beta2,
+                             eps=cfg.adam_eps, weight_decay=cfg.weight_decay,
+                             amsgrad=cfg.amsgrad)
         return adam(lr=cfg.lr, b1=cfg.adam_beta1, b2=cfg.adam_beta2,
                     eps=cfg.adam_eps, weight_decay=cfg.weight_decay,
                     amsgrad=cfg.amsgrad)
