@@ -1,80 +1,181 @@
 #!/usr/bin/env python
-"""Headline benchmark: ResNet-18 / CIFAR-10-shaped training throughput.
+"""Headline benchmark: ResNet-18 / CIFAR-10 training throughput + MFU.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints exactly ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}
+— on success AND on failure. The round-1 lesson (BENCH_r01.json was an
+unparseable backend-init traceback) is baked into the design:
+
+- the measurement runs in a CHILD process; the parent enforces a hard
+  timeout per attempt, so a hung TPU-tunnel init can never hang the bench;
+- TPU init is retried (the axon relay has been observed to come up late);
+- if every TPU attempt fails, a clearly-labeled CPU fallback still produces
+  a parseable line (platform=cpu, fallback=true) carrying the error chain.
+
+Extra fields beyond the driver schema: sec_per_step, mfu, flops_per_image,
+platform, device_kind, attempts.
 
 Baseline derivation (vs_baseline): the reference publishes no absolute
 throughput (BASELINE.md); its headline distributed config is ResNet-18 /
 CIFAR-10 on 8 MPI workers (m4.2xlarge CPUs) at a 5.19x speedup over 1 worker
 (BASELINE.md, b=1024 "normal" speedup row). A single m4.2xlarge (8-vCPU
 Broadwell Xeon) sustains ~80 images/sec on ResNet-18/CIFAR-10 training in
-that era's PyTorch — so the 8-worker MPI cluster's effective rate is
-~80 * 5.19 ~= 415 images/sec. BASELINE.json's target is >=20x that rate
-(>= 8,300 img/s). vs_baseline reported here = measured / 415.
+that era's PyTorch — an ESTIMATE, since the reference measured none — so the
+8-worker MPI cluster's effective rate is ~80 * 5.19 ~= 415 images/sec.
+vs_baseline = measured / 415.
 
-Runs on whatever jax.devices() provides (the real TPU chip under the driver;
-CPU elsewhere). Synthetic CIFAR-shaped data — this measures the training
-step (forward+backward+psum+update), not host input I/O.
+MFU: per-image fwd+bwd FLOPs counted from the traced value_and_grad jaxpr
+(ps_pytorch_tpu/utils/flops.py — measured backward multiple, not the 3x
+rule), divided by the chip's peak bf16 FLOPs (v5e = 197 TF/s/chip).
+
+Synthetic CIFAR-shaped data: this measures the training step
+(forward+backward+psum+update), not host input I/O (bench_suite.py measures
+the loader separately).
 """
 
+import argparse
 import json
+import os
+import subprocess
+import sys
 import time
 
-import jax
-import jax.numpy as jnp
-import numpy as np
-
-BASELINE_IMGS_PER_SEC = 415.0  # 8-worker m4.2xlarge MPI cluster, see docstring
+BASELINE_IMGS_PER_SEC = 415.0  # estimate-derived; see module docstring
+METRIC = "resnet18_cifar10_train_images_per_sec"
 
 
-def main() -> None:
-    from ps_pytorch_tpu.config import TrainConfig
+def child_main(args) -> int:
+    """The actual measurement. Runs under the parent's timeout. Model/state
+    construction and the timing loop are bench_suite.py's (_build/time_steps)
+    so the two benchmarks cannot silently diverge."""
+    import jax
+
+    from bench_suite import _build, time_steps
     from ps_pytorch_tpu.models import build_model
-    from ps_pytorch_tpu.optim import build_optimizer
-    from ps_pytorch_tpu.parallel import (
-        create_train_state, make_mesh, make_train_step,
-    )
+    from ps_pytorch_tpu.utils.flops import peak_flops_bf16, training_flops
 
-    n_dev = len(jax.devices())
-    batch = 1024 * n_dev
-    cfg = TrainConfig(dataset="Cifar10", network="ResNet18", batch_size=batch,
-                      lr=0.1, momentum=0.9, weight_decay=1e-4,
-                      compute_dtype="bfloat16")
-    mesh = make_mesh(data=n_dev)
-    model = build_model(cfg.network, cfg.num_classes, cfg.compute_dtype)
-    tx = build_optimizer(cfg)
-    state = create_train_state(model, tx, mesh, (1, 32, 32, 3), jax.random.key(0))
-    step_fn = make_train_step(model, tx, mesh, state, donate=True)
+    if args.steps < 1 or args.warmup < 1:
+        raise SystemExit("--steps and --warmup must be >= 1")
 
-    rng = np.random.default_rng(0)
-    x = jnp.asarray(rng.normal(size=(batch, 32, 32, 3)).astype(np.float32))
-    y = jnp.asarray(rng.integers(0, 10, batch).astype(np.int32))
-    mask = jnp.ones(n_dev, jnp.float32)
+    t_init = time.perf_counter()
+    devices = jax.devices()
+    init_s = time.perf_counter() - t_init
+    platform = devices[0].platform
+    kind = devices[0].device_kind
 
-    # Warmup (compile) then timed steps. Materialize a scalar each phase —
-    # on the axon remote platform, block_until_ready alone has been observed
-    # to return before the dispatched chain finishes.
-    for i in range(3):
-        state, metrics = step_fn(state, x, y, mask, jax.random.key(i))
-    _ = float(metrics["loss"])
-    jax.block_until_ready(state.params)
+    n_dev = len(devices)
+    batch = args.per_device_batch * n_dev
+    state, step_fn, x, y, mask = _build("ResNet18", "Cifar10", batch)
 
-    steps = 20
-    t0 = time.perf_counter()
-    for i in range(steps):
-        state, metrics = step_fn(state, x, y, mask, jax.random.key(100 + i))
-    jax.block_until_ready(state.params)
-    _ = float(metrics["loss"])
-    dt = time.perf_counter() - t0
+    t_c = time.perf_counter()
+    sec_per_step = time_steps(state, step_fn, x, y, mask,
+                              steps=args.steps, warmup=args.warmup)
+    compile_s = time.perf_counter() - t_c - sec_per_step * args.steps
+    imgs_per_sec = batch / sec_per_step
 
-    imgs_per_sec = steps * batch / dt
+    # FLOPs model: per-image fwd+bwd from the traced grad jaxpr (batch=8 to
+    # keep the trace fast; per-image cost is batch-invariant for these CNNs).
+    model = build_model("ResNet18", 10, "bfloat16")
+    flops_per_image = training_flops(model, (8, 32, 32, 3), 10) / 8
+    peak = peak_flops_bf16(kind)
+    mfu = (flops_per_image * imgs_per_sec) / (peak * n_dev) if peak else None
+
     print(json.dumps({
-        "metric": "resnet18_cifar10_train_images_per_sec",
+        "metric": METRIC,
         "value": round(imgs_per_sec, 1),
         "unit": "images/sec",
         "vs_baseline": round(imgs_per_sec / BASELINE_IMGS_PER_SEC, 2),
+        "sec_per_step": round(sec_per_step, 5),
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "flops_per_image_gf": round(flops_per_image / 1e9, 3),
+        "global_batch": batch,
+        "devices": n_dev,
+        "platform": platform,
+        "device_kind": kind,
+        "init_s": round(init_s, 1),
+        "compile_s": round(compile_s, 1),
+        "baseline_note": "415 img/s = estimate-derived 8-worker MPI rate",
     }))
+    return 0
+
+
+def _run_attempt(label: str, env_overrides: dict, timeout_s: float,
+                 per_device_batch: int, steps: int, warmup: int):
+    """Run one child measurement under a hard timeout.
+    -> (parsed JSON dict or None, error string or None)."""
+    env = dict(os.environ)
+    env.update(env_overrides)
+    cmd = [sys.executable, os.path.abspath(__file__), "--child",
+           "--per-device-batch", str(per_device_batch), "--steps", str(steps),
+           "--warmup", str(warmup)]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=timeout_s, env=env,
+                              cwd=os.path.dirname(os.path.abspath(__file__)))
+    except subprocess.TimeoutExpired:
+        return None, f"{label}: timeout after {timeout_s:.0f}s (backend init or compile hang)"
+    if proc.returncode == 0:
+        for line in reversed(proc.stdout.strip().splitlines()):
+            try:
+                d = json.loads(line)
+                if d.get("metric") == METRIC:
+                    return d, None
+            except json.JSONDecodeError:
+                continue
+        return None, f"{label}: exited 0 but no JSON result line"
+    tail = (proc.stderr or proc.stdout or "").strip().splitlines()[-3:]
+    return None, f"{label}: rc={proc.returncode}: " + " | ".join(tail)[-400:]
+
+
+def parent_main(args) -> int:
+    """Attempt ladder: TPU (retry with backoff) then labeled CPU fallback.
+    Always prints one JSON line; always exits 0 so the driver records it."""
+    attempts = []
+    ladder = [
+        ("tpu-1", {}, args.tpu_timeout, args.per_device_batch, args.steps),
+        ("tpu-2", {}, args.tpu_timeout / 2, args.per_device_batch, args.steps),
+        # CPU fallback: smaller batch & fewer steps (CPU is ~100x slower);
+        # PALLAS_AXON_POOL_IPS= disables the axon sitecustomize registration.
+        ("cpu-fallback",
+         {"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""},
+         args.cpu_timeout, 256, 3),
+    ]
+    for i, (label, env, timeout_s, pdb, steps) in enumerate(ladder):
+        result, err = _run_attempt(label, env, timeout_s, pdb, steps,
+                                   args.warmup)
+        if result is not None:
+            result["attempts"] = attempts + [f"{label}: ok"]
+            if label == "cpu-fallback":
+                result["fallback"] = "cpu"
+            print(json.dumps(result))
+            return 0
+        attempts.append(err)
+        if i == 0:
+            time.sleep(args.backoff)
+    print(json.dumps({
+        "metric": METRIC, "value": 0.0, "unit": "images/sec",
+        "vs_baseline": 0.0, "error": "all attempts failed",
+        "attempts": attempts,
+    }))
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--child", action="store_true",
+                   help="internal: run the measurement in-process")
+    p.add_argument("--per-device-batch", type=int, default=1024)
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--warmup", type=int, default=3)
+    p.add_argument("--tpu-timeout", type=float,
+                   default=float(os.environ.get("BENCH_TPU_TIMEOUT", 900)))
+    p.add_argument("--cpu-timeout", type=float,
+                   default=float(os.environ.get("BENCH_CPU_TIMEOUT", 900)))
+    p.add_argument("--backoff", type=float, default=20.0)
+    args = p.parse_args(argv)
+    if args.child:
+        return child_main(args)
+    return parent_main(args)
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -1,0 +1,7 @@
+from ps_pytorch_tpu.utils.flops import (
+    count_jaxpr_flops, forward_flops, peak_flops_bf16, training_flops,
+)
+
+__all__ = [
+    "count_jaxpr_flops", "forward_flops", "peak_flops_bf16", "training_flops",
+]
