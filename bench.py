@@ -61,6 +61,12 @@ def child_main(args) -> int:
     init_s = time.perf_counter() - t_init
     platform = devices[0].platform
     kind = devices[0].device_kind
+    if args.require_accelerator and platform == "cpu":
+        # A "TPU" ladder attempt resolving to CPU must fail fast and loudly
+        # rather than burn the timeout on a full-size run and report an
+        # unflagged CPU number as the TPU headline.
+        raise SystemExit(f"accelerator required but jax resolved platform="
+                         f"{platform} ({kind})")
 
     n_dev = len(devices)
     batch = args.per_device_batch * n_dev
@@ -99,7 +105,8 @@ def child_main(args) -> int:
 
 
 def _run_attempt(label: str, env_overrides: dict, timeout_s: float,
-                 per_device_batch: int, steps: int, warmup: int):
+                 per_device_batch: int, steps: int, warmup: int,
+                 require_accelerator: bool = False):
     """Run one child measurement under a hard timeout.
     -> (parsed JSON dict or None, error string or None)."""
     env = dict(os.environ)
@@ -107,6 +114,8 @@ def _run_attempt(label: str, env_overrides: dict, timeout_s: float,
     cmd = [sys.executable, os.path.abspath(__file__), "--child",
            "--per-device-batch", str(per_device_batch), "--steps", str(steps),
            "--warmup", str(warmup)]
+    if require_accelerator:
+        cmd.append("--require-accelerator")
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True,
                               timeout=timeout_s, env=env,
@@ -141,7 +150,8 @@ def parent_main(args) -> int:
     ]
     for i, (label, env, timeout_s, pdb, steps) in enumerate(ladder):
         result, err = _run_attempt(label, env, timeout_s, pdb, steps,
-                                   args.warmup)
+                                   args.warmup,
+                                   require_accelerator=label.startswith("tpu"))
         if result is not None:
             result["attempts"] = attempts + [f"{label}: ok"]
             if label == "cpu-fallback":
@@ -163,6 +173,8 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--child", action="store_true",
                    help="internal: run the measurement in-process")
+    p.add_argument("--require-accelerator", action="store_true",
+                   help="internal: fail fast if jax resolves to CPU")
     p.add_argument("--per-device-batch", type=int, default=1024)
     p.add_argument("--steps", type=int, default=20)
     p.add_argument("--warmup", type=int, default=3)

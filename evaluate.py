@@ -14,11 +14,13 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--train-dir", default="./train_dir")
     p.add_argument("--poll-s", type=float, default=10.0)
-    p.add_argument("--once", type=int, default=0,
+    # None-defaults so step/timeout 0 stay expressible (a falsy check would
+    # make `--once 0` / `--stop-after 0` silently mean "disabled").
+    p.add_argument("--once", type=int, default=None,
                    help="evaluate exactly this step then exit")
-    p.add_argument("--stop-after", type=int, default=0,
+    p.add_argument("--stop-after", type=int, default=None,
                    help="exit once this step has been evaluated")
-    p.add_argument("--idle-timeout-s", type=float, default=0.0,
+    p.add_argument("--idle-timeout-s", type=float, default=None,
                    help="exit after this long with no new checkpoints")
     args = p.parse_args(argv)
 
@@ -27,11 +29,11 @@ def main(argv=None) -> int:
     from ps_pytorch_tpu.runtime import Evaluator
 
     ev = Evaluator(args.train_dir, poll_s=args.poll_s)
-    if args.once:
+    if args.once is not None:
         ev.evaluate_step(args.once)
         return 0
-    ev.run(stop_after=args.stop_after or None,
-           idle_timeout_s=args.idle_timeout_s or None)
+    ev.run(stop_after=args.stop_after,
+           idle_timeout_s=args.idle_timeout_s)
     return 0
 
 

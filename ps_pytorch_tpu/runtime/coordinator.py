@@ -94,7 +94,7 @@ class Coordinator:
     def __init__(self, n_replicas: int, mode: str = "sync",
                  num_aggregate: int = 0, kill_threshold: float = 0.0,
                  kv: Optional[KVStore] = None, run_id: str = "run",
-                 leader: bool = True):
+                 leader: bool = True, mask_gc_window: int = 50):
         if mode not in ("sync", "kofn", "async"):
             raise ValueError(f"unknown mode {mode!r}")
         if mode == "kofn" and not (0 < num_aggregate <= n_replicas):
@@ -107,6 +107,7 @@ class Coordinator:
         self.kv = kv or KVStore()
         self.run_id = run_id
         self.leader = leader
+        self.mask_gc_window = max(int(mask_gc_window), 2)
         # last observed per-replica step duration (telemetry; seconds)
         self._last_duration = np.zeros(n_replicas, np.float64)
         self._killed = np.zeros(n_replicas, bool)
@@ -166,10 +167,14 @@ class Coordinator:
                 time.sleep(0.002)
         mask = self._decide_mask()
         self.kv.set(key, json.dumps(mask.tolist()))
-        # GC: a mask is dead one step later; keep the KV O(1) over long runs
-        # (followers may still be reading step-1, so delete step-2).
-        if step >= 2:
-            self.kv.delete(f"{self.run_id}/mask/{step - 2}")
+        # GC with a WIDE window, not step-2: JAX dispatch is async and
+        # followers only synchronize when metrics materialize (log_every), so
+        # a follower can lag many host-loop iterations behind the leader —
+        # deleting a mask it has not yet read would strand it in a 300 s
+        # TimeoutError (round-1 advisor, medium). Masks are ~n_replicas
+        # floats, so retaining `mask_gc_window` of them is still O(1).
+        if step >= self.mask_gc_window:
+            self.kv.delete(f"{self.run_id}/mask/{step - self.mask_gc_window}")
         return mask
 
     def _decide_mask(self) -> np.ndarray:

@@ -33,7 +33,6 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ps_pytorch_tpu.config import TrainConfig
-from ps_pytorch_tpu.data import prepare_data
 from ps_pytorch_tpu.data.datasets import sample_shape
 from ps_pytorch_tpu.models import build_model
 from ps_pytorch_tpu.optim import build_optimizer
@@ -119,16 +118,31 @@ class MultiSliceTrainer:
         self._update = jax.jit(
             lambda p, o, g: apply_optimizer(self.tx, p, o, g))
 
-        self.train_loader, self.test_loader = prepare_data(cfg)
+        # Disjoint-by-construction per-slice data: slice s is "host" s of
+        # n_slices over a shared-seed shuffle (the loader's multi-host shard
+        # discipline), so per-slice coverage no longer depends on tick
+        # scheduling. Each slice still draws cfg.batch_size per step, like a
+        # reference worker (hence the n_slices-scaled loader batch).
+        from ps_pytorch_tpu.data.datasets import DataLoader, load_arrays
+        xtr, ytr = load_arrays(cfg.dataset, cfg.data_dir, train=True,
+                               seed=cfg.seed)
+        self.train_loaders = [
+            DataLoader(xtr, ytr, cfg.batch_size * n_slices, cfg.dataset,
+                       train=True, seed=cfg.seed, host_id=s,
+                       num_hosts=n_slices)
+            for s in range(n_slices)]
+        xte, yte = load_arrays(cfg.dataset, cfg.data_dir, train=False,
+                               seed=cfg.seed)
+        self.test_loader = DataLoader(xte, yte, cfg.test_batch_size,
+                                      cfg.dataset, train=False, shuffle=False,
+                                      seed=cfg.seed, drop_last=False)
         self.metrics = MetricsLogger(cfg.metrics_file, cfg.log_every)
         self.step = 0          # canonical (master) step
         self.applied = 0       # updates actually applied
         self.dropped_stale = 0
 
     def _slice_batch(self, s: int):
-        x, y = self.train_loader.next_batch()
-        # Each slice trains on its own stream position (the loader shuffles
-        # per epoch; slices just consume successive batches).
+        x, y = self.train_loaders[s].next_batch()
         return jnp.asarray(x), jnp.asarray(y)
 
     def tick(self) -> dict:

@@ -82,30 +82,39 @@ def cmd_launch(args, train_argv: List[str]) -> int:
     records = []
     for rank in range(n):
         log_path = os.path.join(args.run_dir, f"proc_{rank}.log")
-        log = open(log_path, "w")
         cmd = [sys.executable, entry] + train_argv
         if hosts is None:
             env = _env_for(rank, n, coordinator, args.platform or "cpu",
                            args.devices_per_host)
-            p = subprocess.Popen(cmd, stdout=log, stderr=subprocess.STDOUT,
-                                 env=env, cwd=args.cwd or None)
+            with open(log_path, "w") as log:
+                # The child inherits its own fd; the parent's copy is closed
+                # immediately (round-1 advisor: fd leak across large fleets).
+                p = subprocess.Popen(cmd, stdout=log, stderr=subprocess.STDOUT,
+                                     env=env, cwd=args.cwd or None)
             records.append({"rank": rank, "host": "local", "pid": p.pid,
                             "log": log_path})
         else:
             # ssh mode: export the env contract inline; the remote side runs
             # against its real local chips (platform override not forced).
-            env_prefix = " ".join(
+            # `echo REMOTE_PID $$` + `exec` publishes the REMOTE python's own
+            # pid into the locally captured log — `p.pid` here is only the
+            # local ssh client, and signalling that number on the remote host
+            # would hit an arbitrary process (round-1 advisor, medium).
+            env_args = " ".join(
                 f"{k}={shlex.quote(v)}" for k, v in {
                     dist.ENV_COORD: coordinator, dist.ENV_NPROC: str(n),
                     dist.ENV_PID: str(rank),
                 }.items())
-            remote = f"cd {shlex.quote(args.cwd or '.')} && {env_prefix} " \
+            remote = f"cd {shlex.quote(args.cwd or '.')} && " \
+                     f"echo REMOTE_PID $$ && exec env {env_args} " \
                      f"{shlex.quote(sys.executable)} {shlex.quote(entry)} " \
                      + " ".join(shlex.quote(a) for a in train_argv)
-            p = subprocess.Popen(["ssh", "-o", "BatchMode=yes", hosts[rank], remote],
-                                 stdout=log, stderr=subprocess.STDOUT)
+            with open(log_path, "w") as log:
+                p = subprocess.Popen(["ssh", "-o", "BatchMode=yes",
+                                      hosts[rank], remote],
+                                     stdout=log, stderr=subprocess.STDOUT)
             records.append({"rank": rank, "host": hosts[rank], "pid": p.pid,
-                            "log": log_path})
+                            "log": log_path, "entry": entry})
     with open(os.path.join(args.run_dir, PROCS_FILE), "w") as f:
         json.dump({"coordinator": coordinator, "n": n,
                    "hostfile": args.hostfile, "procs": records}, f, indent=1)
@@ -121,13 +130,14 @@ def _load_procs(run_dir: str) -> dict:
 
 
 def _alive(pid: int) -> bool:
-    # Reap any of our exited children first — otherwise they linger as
-    # zombies and os.kill(pid, 0) keeps reporting them alive.
+    # Reap THIS pid if it is our exited child — otherwise it lingers as a
+    # zombie and os.kill(pid, 0) keeps reporting it alive. Never waitpid(-1):
+    # that steals exit statuses from unrelated children when launch is used
+    # as a library (round-1 advisor).
     try:
-        while os.waitpid(-1, os.WNOHANG) != (0, 0):
-            pass
+        os.waitpid(pid, os.WNOHANG)
     except ChildProcessError:
-        pass
+        pass  # not our child (or already reaped) — /proc check below decides
     try:
         os.kill(pid, 0)
     except (ProcessLookupError, PermissionError):
@@ -177,9 +187,27 @@ def cmd_wait(args) -> int:
             cmd_kill(args)
             return 2
         time.sleep(0.5)
-    ok = all("FINAL" in open(r["log"]).read() for r in meta["procs"])
+
+    def _has_final(path: str) -> bool:
+        with open(path) as f:
+            return "FINAL" in f.read()
+
+    ok = all(_has_final(r["log"]) for r in meta["procs"])
     print(f"DONE ok={ok}")
     return 0 if ok else 1
+
+
+def _remote_pid(record: dict) -> Optional[int]:
+    """The REMOTE trainer's own pid, parsed from the 'REMOTE_PID <n>' line
+    its launch wrapper echoed into the locally captured log."""
+    try:
+        with open(record["log"]) as f:
+            for line in f:
+                if line.startswith("REMOTE_PID "):
+                    return int(line.split()[1])
+    except (OSError, ValueError, IndexError):
+        pass
+    return None
 
 
 def cmd_kill(args) -> int:
@@ -187,13 +215,26 @@ def cmd_kill(args) -> int:
     for sig in (signal.SIGTERM, signal.SIGKILL):
         any_alive = False
         for r in meta["procs"]:
-            if r["host"] not in ("local",):
-                subprocess.run(["ssh", "-o", "BatchMode=yes", r["host"],
-                                f"kill -{int(sig)} {r['pid']}"],
-                               capture_output=True)
+            # r["pid"] is the liveness proxy either way: in ssh mode it is
+            # the local ssh client, which exits when the remote command does
+            # — so remote fleets get the same grace-then-SIGKILL escalation
+            # as local ones instead of a single fire-and-forget SIGTERM.
+            if not _alive(r["pid"]):
                 continue
-            if _alive(r["pid"]):
-                any_alive = True
+            any_alive = True
+            if r["host"] not in ("local",):
+                # Signal the REMOTE trainer's own pid (parsed from its log);
+                # fall back to pkill by entry-script match — the semantic
+                # equivalent of the reference fleet tool's kill-all-python,
+                # scoped to this job's entry (tools/pytorch_ec2.py:821-852).
+                rpid = _remote_pid(r)
+                if rpid is not None:
+                    cmd = f"kill -{int(sig)} {rpid}"
+                else:
+                    cmd = f"pkill -{int(sig)} -f {shlex.quote(r.get('entry', 'train.py'))}"
+                subprocess.run(["ssh", "-o", "BatchMode=yes", r["host"], cmd],
+                               capture_output=True)
+            else:
                 try:
                     os.kill(r["pid"], sig)
                 except ProcessLookupError:

@@ -24,10 +24,7 @@ import jax.numpy as jnp
 
 
 def _prod(xs: Iterable[int]) -> int:
-    out = 1
-    for x in xs:
-        out *= int(x)
-    return out
+    return math.prod(int(x) for x in xs)
 
 
 def _dot_general_flops(eqn) -> int:

@@ -27,6 +27,19 @@ def test_sync_rate_slices_all_contribute():
     assert t.applied == 1
 
 
+def test_per_slice_data_disjoint_by_construction():
+    """Slices shard the dataset like hosts do (shared-seed shuffle, disjoint
+    contiguous slices) — coverage must not depend on tick scheduling
+    (round-1 weak item 6)."""
+    from ps_pytorch_tpu.runtime.multislice import MultiSliceTrainer
+
+    t = MultiSliceTrainer(_cfg(), n_slices=2)
+    o0 = t.train_loaders[0]._epoch_order(0)
+    o1 = t.train_loaders[1]._epoch_order(0)
+    assert set(o0).isdisjoint(o1)
+    assert t.train_loaders[0].local_batch == t.cfg.batch_size
+
+
 def test_slow_slice_submits_stale_but_fresh_enough():
     """Slice 1 runs at half rate and re-fetches weights every 2 of its own
     steps: its contributions are stale (version < step-1) yet within

@@ -80,6 +80,22 @@ def test_coordinator_deadline_and_kill():
     assert m.sum() >= 1 and m[2] == 0
 
 
+def test_coordinator_mask_gc_window():
+    """A follower lagging many host-loop iterations (async dispatch +
+    log_every gaps) must still find old masks on the KV: GC keeps a wide
+    window, not step-2 (round-1 advisor, medium)."""
+    c = Coordinator(2, mode="sync", mask_gc_window=50)
+    for step in range(1, 61):
+        c.participation_mask(step)
+    follower = Coordinator(2, mode="sync", kv=c.kv, leader=False)
+    # 49 behind the leader: still readable.
+    np.testing.assert_array_equal(
+        follower.participation_mask(60 - 49, timeout_s=1.0), [1, 1])
+    # Beyond the window: GC'd (leader at 60 deleted <= 10).
+    with pytest.raises(TimeoutError):
+        follower.participation_mask(9, timeout_s=0.1)
+
+
 def test_coordinator_validates():
     with pytest.raises(ValueError):
         Coordinator(4, mode="kofn", num_aggregate=0)

@@ -127,6 +127,38 @@ def test_launch_hostfile_parse(tmp_path):
     assert _read_hostfile(str(hf)) == ["10.0.0.1", "10.0.0.2"]
 
 
+def test_remote_pid_parsed_from_log(tmp_path):
+    """ssh-mode kill must target the REMOTE trainer's own pid (echoed by the
+    launch wrapper), not the local ssh client's (round-1 advisor, medium)."""
+    from ps_pytorch_tpu.tools.launch import _remote_pid
+
+    log = tmp_path / "proc_0.log"
+    log.write_text("REMOTE_PID 4242\nDIST process 0/2\n")
+    assert _remote_pid({"log": str(log)}) == 4242
+    log.write_text("no pid line here\n")
+    assert _remote_pid({"log": str(log)}) is None
+    assert _remote_pid({"log": str(tmp_path / "missing.log")}) is None
+
+
+def test_alive_does_not_reap_unrelated_children():
+    """_alive must only reap the pid it was asked about — waitpid(-1) would
+    steal exit statuses from other subprocess.Popen children of a library
+    caller (round-1 advisor)."""
+    import subprocess
+    import sys
+    import time as _time
+
+    from ps_pytorch_tpu.tools.launch import _alive
+
+    other = subprocess.Popen([sys.executable, "-c", "print('x')"])
+    _time.sleep(0.5)  # let it exit so it is reapable
+    gone = subprocess.Popen([sys.executable, "-c", "pass"])
+    gone.wait()
+    # Probing an unrelated pid must not consume `other`'s exit status.
+    _alive(gone.pid)
+    assert other.wait(timeout=10) == 0
+
+
 @pytest.mark.slow
 def test_kill_and_resume(tmp_path):
     """Failure recovery: kill a 2-process run mid-training, relaunch with
