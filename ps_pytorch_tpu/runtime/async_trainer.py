@@ -1,0 +1,299 @@
+"""Cross-process asynchronous (stale-gradient) training — one slice per OS
+process, gradients crossing the process/DCN boundary as codec-compressed
+bytes over the coordination-service KV (parallel/transport.py).
+
+This is the multi-machine async story the reference ran (workers shipping
+staleness-tagged gradients to a master across ranks,
+``resnet_split.py:25-42`` + ``sync_replicas_master_nn.py:156-186``),
+re-expressed TPU-natively:
+
+- each process drives an SPMD slice over its OWN local devices (in-slice
+  gradient averaging is an in-graph psum riding ICI);
+- process 0 is the PS leader: it owns the optimizer state (like the
+  reference master, ``optim/sgd.py:80-90`` momentum lives master-side),
+  pools cross-process contributions with staleness metadata
+  (parallel/async_dp.StaleGradientAggregator), applies fresh-enough updates,
+  and publishes canonical weights;
+- followers fetch canonical weights every ``fetch_every`` of their own
+  steps, so a slow follower naturally submits stale gradients — exercising
+  drop/decay exactly as the reference's timeout-kill discards identifiably
+  late gradients (``resnet_split.py:617-728``).
+
+Within one process (no jax.distributed), use runtime/multislice.py instead:
+same semantics with device-group slices.
+"""
+
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ps_pytorch_tpu.config import TrainConfig
+from ps_pytorch_tpu.data.datasets import DataLoader, load_arrays, sample_shape
+from ps_pytorch_tpu.models import build_model
+from ps_pytorch_tpu.optim import build_optimizer
+from ps_pytorch_tpu.parallel.async_dp import StaleGradientAggregator
+from ps_pytorch_tpu.parallel.dp import apply_optimizer, make_eval_step
+from ps_pytorch_tpu.parallel.mesh import make_mesh
+from ps_pytorch_tpu.parallel.transport import KVGradientTransport
+from ps_pytorch_tpu.runtime import checkpoint as ckpt
+from ps_pytorch_tpu.runtime.coordinator import DistributedKV, KVStore
+from ps_pytorch_tpu.runtime.metrics import MetricsLogger
+from ps_pytorch_tpu.runtime.multislice import make_slice_grad_fn
+
+
+class AsyncTrainer:
+    """PS-style async training across jax.distributed processes."""
+
+    def __init__(self, cfg: TrainConfig, kv: Optional[KVStore] = None):
+        self.cfg = cfg
+        self.pid = jax.process_index()
+        self.n = jax.process_count()
+        self.leader = self.pid == 0
+        devices = jax.local_devices()
+        self.mesh = make_mesh(data=len(devices), devices=devices)
+        self.model = build_model(cfg.network, cfg.num_classes, cfg.compute_dtype)
+        self.tx = build_optimizer(cfg)
+
+        shape = (1,) + sample_shape(cfg.dataset)
+        variables = self.model.init(jax.random.key(cfg.seed),
+                                    jnp.zeros(shape, jnp.float32), train=False)
+        # Same seed everywhere -> every process starts from identical weights
+        # (the reference broadcasts initial weights; here the bcast is free).
+        self.params = jax.device_get(variables["params"])
+        self.has_bn = "batch_stats" in variables
+        bs0 = variables.get("batch_stats", {})
+        per = len(devices)
+        self._bs = jax.device_get(jax.tree.map(
+            lambda a: np.tile(a[None], (per,) + (1,) * a.ndim), bs0))
+        self.grad_fn = make_slice_grad_fn(self.model, self.mesh, self.has_bn)
+
+        if kv is None:
+            kv = DistributedKV() if self.n > 1 else KVStore()
+        # Wire format honors the same flags as the in-process aggregator
+        # (--compress-grad / --grad-codec): off -> raw npy framing;
+        # blosc -> C++ lossless; int8 -> on-device Pallas quantization, the
+        # components then blosc-framed (4x smaller before the bytes leave
+        # the chip).
+        self._wire_int8 = cfg.compress_grad and cfg.grad_codec == "int8"
+        chan_codec = "blosc" if cfg.compress_grad else "raw"
+        grad_template = self.params if not self._wire_int8 else \
+            jax.tree.map(lambda a: {"v": np.zeros(0, np.int8),
+                                    "s": np.zeros(0, np.float32)}, self.params)
+        # Canonical publish carries params AND the leader's replica-0 BN
+        # stats, so every process evaluates identical state (the reference
+        # evaluator scores the master's checkpoint, which includes whatever
+        # BN stats the checkpointing worker had).
+        self._bs0 = lambda: jax.tree.map(lambda a: a[0], self._bs)
+        param_template = {"params": self.params, "bs0": self._bs0()}
+        self.transport = KVGradientTransport(
+            kv, self.n, grad_template=grad_template,
+            param_template=param_template, run_id=f"async-{cfg.seed}",
+            level=cfg.codec_level, codec=chan_codec)
+
+        # Per-slice data: this process is shard pid-of-n over the shared-seed
+        # shuffle; each slice draws cfg.batch_size per step like a reference
+        # worker.
+        xtr, ytr = load_arrays(cfg.dataset, cfg.data_dir, train=True,
+                               seed=cfg.seed)
+        self.train_loader = DataLoader(
+            xtr, ytr, cfg.batch_size * self.n, cfg.dataset, train=True,
+            seed=cfg.seed, host_id=self.pid, num_hosts=self.n)
+        xte, yte = load_arrays(cfg.dataset, cfg.data_dir, train=False,
+                               seed=cfg.seed)
+        self.test_loader = DataLoader(xte, yte, cfg.test_batch_size,
+                                      cfg.dataset, train=False, shuffle=False,
+                                      seed=cfg.seed, drop_last=False)
+
+        self.metrics = MetricsLogger(cfg.metrics_file, cfg.log_every)
+        self.version = 0        # canonical PS step (leader-owned)
+        self.applied = 0
+        self.dropped_stale = 0
+        self._seq = 0
+        if self.leader:
+            self.opt_state = jax.device_get(self.tx.init(variables["params"]))
+            self.aggregator = StaleGradientAggregator(
+                self.n, staleness_limit=cfg.staleness_limit,
+                staleness_decay=cfg.staleness_decay,
+                num_aggregate=cfg.num_aggregate,
+                compress=False)  # the WIRE is compressed; the pool is local
+            self._update = jax.jit(
+                lambda p, o, g: apply_optimizer(self.tx, p, o, g))
+
+    # ---- checkpoint/resume (leader authority, sync-Trainer contract) ----
+    def _as_train_state(self):
+        from ps_pytorch_tpu.parallel.dp import TrainState
+        return TrainState(step=jnp.asarray(self.version, jnp.int32),
+                          params=self.params, opt_state=self.opt_state,
+                          batch_stats=self._bs)
+
+    def _checkpoint(self) -> None:
+        ckpt.save_checkpoint(self.cfg.train_dir, self.version,
+                             jax.device_get(self._as_train_state()),
+                             config_json=self.cfg.to_json(),
+                             compress=self.cfg.compress_grad,
+                             codec_level=self.cfg.codec_level)
+
+    def _maybe_resume(self) -> bool:
+        step = ckpt.latest_step(self.cfg.train_dir)
+        if step is None:
+            return False
+        state, meta, _ = ckpt.load_checkpoint(
+            self.cfg.train_dir, step, jax.device_get(self._as_train_state()))
+        self.params, self.opt_state = state.params, state.opt_state
+        self._bs = state.batch_stats
+        self.version = int(meta["step"])
+        print(f"RESUME from {ckpt.checkpoint_path(self.cfg.train_dir, step)} "
+              f"at step {self.version}")
+        return True
+
+    # ---- wire codecs ----
+    def _encode_grads(self, grads):
+        if not self._wire_int8:
+            return jax.device_get(grads)
+        from ps_pytorch_tpu.ops.quantize import quantize_int8
+        key = jax.random.key(self.cfg.seed * 31 + self._seq * self.n + self.pid)
+        leaves, treedef = jax.tree.flatten(grads)
+        enc = []
+        for i, leaf in enumerate(leaves):
+            qt = quantize_int8(leaf, jax.random.fold_in(key, i))
+            enc.append({"v": np.asarray(qt.values), "s": np.asarray(qt.scales)})
+        return jax.tree.unflatten(treedef, enc)
+
+    def _decode_grads(self, wire):
+        if not self._wire_int8:
+            return wire
+        from ps_pytorch_tpu.ops.quantize import (
+            QuantizedTensor, dequantize_int8,
+        )
+
+        def leaf(enc, tpl):
+            qt = QuantizedTensor(values=jnp.asarray(enc["v"]),
+                                 scales=jnp.asarray(enc["s"]),
+                                 shape=tuple(tpl.shape), size=int(tpl.size))
+            return np.asarray(dequantize_int8(qt))
+        # Wire leaves are {"v","s"} dicts; pair them with the params
+        # template for shape/size by walking the flattened orders.
+        wire_leaves = jax.tree.flatten(
+            wire, is_leaf=lambda x: isinstance(x, dict) and "v" in x)[0]
+        tpl_leaves, treedef = jax.tree.flatten(self.params)
+        return jax.tree.unflatten(
+            treedef, [leaf(e, t) for e, t in zip(wire_leaves, tpl_leaves)])
+
+    # ---- the two roles ----
+    def _publish_canonical(self) -> None:
+        self.transport.publish_params(
+            self.version, {"params": jax.device_get(self.params),
+                           "bs0": jax.device_get(self._bs0())})
+
+    def _compute_and_submit(self, version_used: int) -> dict:
+        x, y = self.train_loader.next_batch()
+        grads, m, new_bs = self.grad_fn(
+            self.params, self._bs, jnp.asarray(x), jnp.asarray(y),
+            jax.random.PRNGKey(self.cfg.seed * 7919
+                               + self._seq * 13 + self.pid))
+        self._bs = new_bs
+        self._seq += 1
+        self.transport.submit_grads(self.pid, self._seq, version_used,
+                                    self._encode_grads(grads))
+        return {"loss": float(m["loss"]), "acc": float(m["accuracy"])}
+
+    def _leader_apply(self) -> int:
+        """Pool new wire contributions and apply at most one update.
+        Returns number of contributions used."""
+        for s, step, wire in self.transport.poll_new_grads():
+            self.aggregator.submit(s, step, self._decode_grads(wire))
+        avg, pool = self.aggregator.collect(self.version)
+        used = 0
+        if avg is not None and pool["used"]:
+            self.params, self.opt_state = jax.device_get(self._update(
+                self.params, self.opt_state, avg))
+            self.version += 1
+            self.applied += 1
+            used = len(pool["used"])
+            self.aggregator.consume(pool["used"])
+            self._publish_canonical()
+            if self.cfg.eval_freq > 0 and self.version % self.cfg.eval_freq == 0:
+                self._checkpoint()
+        self.dropped_stale += self.aggregator.drop_older_than(self.version)
+        return used
+
+    def train(self):
+        cfg = self.cfg
+        my_version = 0
+        if self.leader:
+            if cfg.resume:
+                self._maybe_resume()
+            # Canonical start weights (fresh or resumed) become visible to
+            # followers before anyone trains.
+            self._publish_canonical()
+        else:
+            # Block on the leader's initial publish (the reference worker's
+            # first blocking step-fetch, distributed_worker.py:193-199).
+            deadline = time.monotonic() + 120.0
+            while True:
+                got = self.transport.fetch_params()
+                if got is not None:
+                    my_version, tree = got
+                    self.params = tree["params"]
+                    break
+                if time.monotonic() > deadline:
+                    raise TimeoutError("no initial params from leader")
+                time.sleep(0.05)
+
+        own_steps = 0
+        # Safety valve for followers if the leader dies before set_done:
+        # bounded loop, generous multiple of the canonical target.
+        max_own = cfg.max_steps * 50 + 100
+        while own_steps < max_own:
+            t0 = time.monotonic()
+            done = self.transport.done()
+            if done is not None and (not self.leader):
+                break
+            if self.leader and self.version >= cfg.max_steps:
+                break
+            if self.leader:
+                # The leader's params ARE canonical — no KV readback, and
+                # its contributions carry the true current version.
+                my_version = self.version
+            elif own_steps % self.fetch_every == 0:
+                got = self.transport.fetch_params()
+                if got is not None and got[0] > my_version:
+                    my_version, tree = got
+                    self.params = tree["params"]
+            m = self._compute_and_submit(my_version)
+            own_steps += 1
+            used = self._leader_apply() if self.leader else 0
+            step_for_log = self.version if self.leader else own_steps
+            if step_for_log and step_for_log % cfg.log_every == 0:
+                self.metrics.log_step(
+                    step_for_log, 0, loss=m["loss"], acc=m["acc"],
+                    participating=float(used),
+                    step_time=time.monotonic() - t0, data_time=0.0,
+                    applied=self.applied, dropped_stale=self.dropped_stale)
+        if self.leader:
+            if cfg.eval_freq > 0 and self.version % cfg.eval_freq != 0:
+                self._checkpoint()
+            self.transport.set_done(self.version)
+        self.metrics.close()
+        return self.params
+
+    @property
+    def fetch_every(self) -> int:
+        return max(self.cfg.fetch_every, 1)
+
+    def evaluate(self, max_batches: Optional[int] = None) -> dict:
+        """Every process evaluates the CANONICAL state — params AND the
+        leader's replica-0 BN stats from the final publish — so all FINAL
+        lines agree even for BN networks. The reference evaluator likewise
+        scores the master's checkpoint."""
+        got = self.transport.fetch_params()
+        if got is not None:
+            params, bs0 = got[1]["params"], got[1]["bs0"]
+        else:
+            params, bs0 = self.params, self._bs0()
+        from ps_pytorch_tpu.runtime.evaluator import accumulate_eval
+        return accumulate_eval(make_eval_step(self.model), params, bs0,
+                               self.test_loader.epoch(0), max_batches)

@@ -31,19 +31,24 @@ def main(argv=None) -> int:
     cfg = config_from_args(argv)
     print(f"CONFIG {cfg.to_json()}")
     if cfg.mode == "async":
-        # Multi-slice stale-gradient training (the reference's async mode):
-        # device groups act as independent slices feeding the aggregator.
         import jax
         if jax.process_count() > 1:
-            raise SystemExit(
-                "--mode async is single-process (slices are device groups of "
-                "one host); run it per pod-slice, with cross-slice "
-                "aggregation over your DCN transport (parallel/async_dp.py)")
-        from ps_pytorch_tpu.runtime.multislice import MultiSliceTrainer
-        trainer = MultiSliceTrainer(cfg, n_slices=cfg.async_slices,
-                                    fetch_every=cfg.fetch_every)
-        print(f"SLICES {cfg.async_slices} x "
-              f"{len(trainer.meshes[0].devices.flat)} devices")
+            # One slice per process: gradients cross the process/DCN
+            # boundary codec-compressed over the coordination-service KV
+            # (runtime/async_trainer.py) — the reference's cross-machine
+            # async path (resnet_split.py:25-42 staleness tags).
+            from ps_pytorch_tpu.runtime.async_trainer import AsyncTrainer
+            trainer = AsyncTrainer(cfg)
+            print(f"ASYNC process-slices {trainer.n} x "
+                  f"{len(trainer.mesh.devices.flat)} devices")
+        else:
+            # Single process: device groups act as independent slices
+            # feeding the aggregator in-process (runtime/multislice.py).
+            from ps_pytorch_tpu.runtime.multislice import MultiSliceTrainer
+            trainer = MultiSliceTrainer(cfg, n_slices=cfg.async_slices,
+                                        fetch_every=cfg.fetch_every)
+            print(f"SLICES {cfg.async_slices} x "
+                  f"{len(trainer.meshes[0].devices.flat)} devices")
     else:
         trainer = Trainer(cfg)
         print(f"MESH data={trainer.mesh.shape['data']} model={trainer.mesh.shape['model']} "
