@@ -34,6 +34,10 @@ class TrainConfig:
     # -- optimization (reference: distributed_nn.py:36-44, optim/sgd.py, optim/adam.py) --
     optimizer: str = "sgd"           # sgd|adam
     lr: float = 0.01
+    lr_schedule: str = "constant"    # constant|step|cosine (optim/schedules.py; reference tuned a constant via tune.sh)
+    lr_warmup_steps: int = 0         # linear 0->lr prefix
+    lr_decay_steps: int = 0          # step period / cosine horizon; 0 = max_steps
+    lr_decay_factor: float = 0.1     # step gamma / cosine floor fraction
     momentum: float = 0.5
     weight_decay: float = 0.0
     nesterov: bool = False
@@ -88,6 +92,9 @@ class TrainConfig:
             self.num_classes = DATASET_SHAPES.get(self.dataset, (0, 0, 0, 10, 0))[3]
         if self.mode not in ("sync", "kofn", "async"):
             raise ValueError(f"unknown mode {self.mode!r}")
+        if self.lr_schedule not in ("constant", "step", "cosine"):
+            raise ValueError(f"unknown lr_schedule {self.lr_schedule!r} "
+                             "(constant | step | cosine)")
         if self.grad_codec not in ("blosc", "int8"):
             raise ValueError(f"unknown grad_codec {self.grad_codec!r} (blosc | int8)")
         if self.nesterov and (self.momentum <= 0):

@@ -10,25 +10,29 @@ numpy transcription of the torch update rules).
 
 from ps_pytorch_tpu.optim.sgd import sgd  # noqa: F401
 from ps_pytorch_tpu.optim.adam import adam  # noqa: F401
+from ps_pytorch_tpu.optim.schedules import build_schedule  # noqa: F401
 
 
 def build_optimizer(cfg):
     """Config -> GradientTransformation (reference: master build_model wires
-    SGD at ``sync_replicas_master_nn.py:124-131``)."""
+    SGD at ``sync_replicas_master_nn.py:124-131``). The lr argument is a
+    float or a ``step -> lr`` schedule (optim/schedules.py); both optimizer
+    families accept either."""
+    lr = build_schedule(cfg)
     if cfg.optimizer == "sgd":
         if getattr(cfg, "fused_optimizer", False):
             from ps_pytorch_tpu.ops.fused_sgd import FusedSGD
-            return FusedSGD(lr=cfg.lr, momentum=cfg.momentum,
+            return FusedSGD(lr=lr, momentum=cfg.momentum,
                             weight_decay=cfg.weight_decay, nesterov=cfg.nesterov)
-        return sgd(lr=cfg.lr, momentum=cfg.momentum,
+        return sgd(lr=lr, momentum=cfg.momentum,
                    weight_decay=cfg.weight_decay, nesterov=cfg.nesterov)
     if cfg.optimizer == "adam":
         if getattr(cfg, "fused_optimizer", False):
             from ps_pytorch_tpu.ops.fused_adam import FusedAdam
-            return FusedAdam(lr=cfg.lr, b1=cfg.adam_beta1, b2=cfg.adam_beta2,
+            return FusedAdam(lr=lr, b1=cfg.adam_beta1, b2=cfg.adam_beta2,
                              eps=cfg.adam_eps, weight_decay=cfg.weight_decay,
                              amsgrad=cfg.amsgrad)
-        return adam(lr=cfg.lr, b1=cfg.adam_beta1, b2=cfg.adam_beta2,
+        return adam(lr=lr, b1=cfg.adam_beta1, b2=cfg.adam_beta2,
                     eps=cfg.adam_eps, weight_decay=cfg.weight_decay,
                     amsgrad=cfg.amsgrad)
     raise ValueError(f"unknown optimizer {cfg.optimizer!r}")

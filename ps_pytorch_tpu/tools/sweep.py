@@ -27,10 +27,13 @@ from ps_pytorch_tpu.runtime.metrics import parse_line
 
 def run_trial(lr: float, probe_step: int, train_argv: List[str],
               entry: str = "train.py", avg_last: int = 1,
+              schedule: str = "constant",
               extra_env: Optional[dict] = None) -> dict:
-    """One training subprocess at this lr; -> {"lr", "loss", "acc", "steps"}."""
+    """One training subprocess at this (lr, schedule);
+    -> {"lr", "schedule", "loss", "acc", "steps"}."""
     import os
     cmd = [sys.executable, entry, "--lr", str(lr),
+           "--lr-schedule", schedule,
            "--max-steps", str(probe_step), "--log-every", "1",
            "--eval-freq", "0", "--resume", "false"] + train_argv
     env = dict(os.environ)
@@ -38,13 +41,15 @@ def run_trial(lr: float, probe_step: int, train_argv: List[str],
     out = subprocess.run(cmd, capture_output=True, text=True, env=env)
     records = [r for r in (parse_line(l) for l in out.stdout.splitlines()) if r]
     if out.returncode != 0 or not records:
-        return {"lr": lr, "loss": float("nan"), "acc": float("nan"),
-                "steps": len(records), "error": out.stderr[-500:]}
+        return {"lr": lr, "schedule": schedule, "loss": float("nan"),
+                "acc": float("nan"), "steps": len(records),
+                "error": out.stderr[-500:]}
     # Average the last k probe losses (the reference averages its 16 workers'
     # step-N lines; one SPMD process emits one line per step, so average over
     # trailing steps for the same smoothing effect).
     tail = records[-avg_last:]
-    return {"lr": lr, "loss": statistics.fmean(r["loss"] for r in tail),
+    return {"lr": lr, "schedule": schedule,
+            "loss": statistics.fmean(r["loss"] for r in tail),
             "acc": statistics.fmean(r["acc"] for r in tail),
             "steps": len(records)}
 
@@ -59,6 +64,9 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--lrs", default="0.005,0.01,0.02,0.05,0.1,0.2,0.4",
                    help="comma-separated grid (7 values, like tune.sh)")
+    p.add_argument("--schedules", default="constant",
+                   help="comma-separated lr_schedule axis "
+                        "(constant|step|cosine); grid = lrs x schedules")
     p.add_argument("--probe-step", type=int, default=20,
                    help="train this many steps; rank by loss there")
     p.add_argument("--avg-last", type=int, default=3)
@@ -66,17 +74,19 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
 
     results = []
-    for lr in (float(s) for s in args.lrs.split(",")):
-        r = run_trial(lr, args.probe_step, train_argv, entry=args.entry,
-                      avg_last=args.avg_last)
-        print(json.dumps(r))
-        results.append(r)
+    for schedule in args.schedules.split(","):
+        for lr in (float(s) for s in args.lrs.split(",")):
+            r = run_trial(lr, args.probe_step, train_argv, entry=args.entry,
+                          avg_last=args.avg_last, schedule=schedule.strip())
+            print(json.dumps(r))
+            results.append(r)
     valid = [r for r in results if r["loss"] == r["loss"]]  # drop NaNs
     if not valid:
         print("BEST none (all trials failed)", file=sys.stderr)
         return 1
     best = min(valid, key=lambda r: r["loss"])
-    print(f"BEST lr={best['lr']:g} loss={best['loss']:.6f} acc={best['acc']:.4f}")
+    print(f"BEST lr={best['lr']:g} schedule={best['schedule']} "
+          f"loss={best['loss']:.6f} acc={best['acc']:.4f}")
     return 0
 
 
