@@ -69,6 +69,7 @@ class TrainConfig:
 
     # -- numerics / TPU --
     compute_dtype: str = "bfloat16"  # MXU-native compute dtype; params stay float32
+    device_normalize: bool = True    # loaders ship raw uint8; the jitted step normalizes in-graph (4x less host->device traffic)
     fused_optimizer: bool = False    # Pallas single-pass SGD update (ops/fused_sgd.py)
     donate: bool = True              # donate buffers to the jitted step
     remat: bool = False              # jax.checkpoint the forward for memory
@@ -77,6 +78,11 @@ class TrainConfig:
     compress_grad: bool = False      # compress DCN-crossing gradient mirrors / checkpoints
     codec_level: int = 3
     grad_codec: str = "blosc"        # blosc (lossless, native C++) | int8 (on-device Pallas)
+
+    # -- fault injection (tests / straggler drills; SURVEY §5.3: the
+    #    reference had none) --
+    inject_step_delay: float = 0.0   # seconds of artificial per-step delay
+    inject_delay_process: int = -1   # process_index to slow; -1 = nobody
 
     # -- logging / profiling --
     log_every: int = 1

@@ -34,6 +34,9 @@ DATASET_SHAPES = {
     "SVHN": (32, 32, 3, 10, 73257),
     "synthetic": (32, 32, 3, 10, 50000),
     "synthetic_mnist": (28, 28, 1, 10, 60000),
+    # Synthetic data run through the REAL CIFAR augment stack (pad/crop/
+    # flip/normalize) — for loader-throughput benches without dataset files.
+    "synthetic_cifar10": (32, 32, 3, 10, 50000),
     # ImageNet-shaped synthetic set for the ResNet-50 at-scale config
     # (BASELINE.json config 5); small N — it exists to exercise 224px
     # shapes/throughput, not to be learned.
@@ -69,7 +72,9 @@ def _load_torchvision(name: str, root: str, train: bool, download: bool):
         y = ds.labels
     else:
         raise ValueError(name)
-    return x.astype(np.float32) / 255.0, y.astype(np.int32)
+    # Keep raw uint8: 4x fewer bytes through the shuffle/pad/crop hot path;
+    # the augment stack folds /255 into its fused normalize.
+    return x.astype(np.uint8, copy=False), y.astype(np.int32)
 
 
 def _synthetic(name: str, train: bool, seed: int = 0):
@@ -83,7 +88,12 @@ def _synthetic(name: str, train: bool, seed: int = 0):
     y = rng.integers(0, ncls, size=n).astype(np.int32)
     x = rng.normal(0.5, 0.25, size=(n, h, w, c)).astype(np.float32)
     x += (y[:, None, None, None].astype(np.float32) / ncls - 0.5) * 0.5
-    return np.clip(x, 0.0, 1.0), y
+    x = np.clip(x, 0.0, 1.0)
+    if name == "synthetic_cifar10":
+        # Mimic the real pipeline end to end: uint8 storage + the full
+        # CIFAR augment stack (loader-throughput bench fidelity).
+        x = (x * 255.0).astype(np.uint8)
+    return x, y
 
 
 def load_arrays(dataset: str, data_dir: str = "./data", train: bool = True,
@@ -106,11 +116,15 @@ class DataLoader:
                  dataset: str = "synthetic", train: bool = True,
                  shuffle: Optional[bool] = None, seed: int = 0,
                  host_id: int = 0, num_hosts: int = 1, prefetch: int = 2,
-                 drop_last: bool = True):
+                 drop_last: bool = True, device_normalize: bool = False):
         assert len(x) == len(y)
         self.x, self.y = x, y
         self.dataset = dataset
         self.train = train
+        # device_normalize: emit raw (uint8) batches; the jitted step
+        # normalizes in-graph (augment.device_norm_constants) — 4x less
+        # host->device traffic and no host normalize pass.
+        self.device_normalize = device_normalize
         self.shuffle = train if shuffle is None else shuffle
         self.seed = seed
         self.host_id, self.num_hosts = host_id, num_hosts
@@ -166,10 +180,13 @@ class DataLoader:
                 for b in range(n):
                     sel = order[b * self.local_batch:(b + 1) * self.local_batch]
                     xb = self.x[sel]
+                    norm_out = not self.device_normalize
                     if self.train:
-                        xb = augment.augment_train(xb, self.dataset, aug_rng)
+                        xb = augment.augment_train(xb, self.dataset, aug_rng,
+                                                   normalize_out=norm_out)
                     else:
-                        xb = augment.transform_test(xb, self.dataset)
+                        xb = augment.transform_test(xb, self.dataset,
+                                                    normalize_out=norm_out)
                     if not _put((xb, self.y[sel])):
                         return
                 _put(None)
@@ -204,13 +221,21 @@ class DataLoader:
 
 def prepare_data(cfg, host_id: int = 0, num_hosts: int = 1,
                  download: bool = False) -> Tuple[DataLoader, DataLoader]:
-    """Config -> (train_loader, test_loader). Reference: ``util.py:21-106``."""
+    """Config -> (train_loader, test_loader). Reference: ``util.py:21-106``.
+
+    When cfg.device_normalize is on (and the dataset has normalization
+    constants), loaders emit raw uint8 and the jitted steps normalize
+    in-graph — the single cfg switch keeps loaders and steps consistent."""
+    from ps_pytorch_tpu.data.augment import input_norm_for
+    dev_norm = input_norm_for(cfg) is not None
     xtr, ytr = load_arrays(cfg.dataset, cfg.data_dir, train=True,
                            download=download, seed=cfg.seed)
     xte, yte = load_arrays(cfg.dataset, cfg.data_dir, train=False,
                            download=download, seed=cfg.seed)
     train = DataLoader(xtr, ytr, cfg.batch_size, cfg.dataset, train=True,
-                       seed=cfg.seed, host_id=host_id, num_hosts=num_hosts)
+                       seed=cfg.seed, host_id=host_id, num_hosts=num_hosts,
+                       device_normalize=dev_norm)
     test = DataLoader(xte, yte, cfg.test_batch_size, cfg.dataset, train=False,
-                      shuffle=False, seed=cfg.seed, drop_last=False)
+                      shuffle=False, seed=cfg.seed, drop_last=False,
+                      device_normalize=dev_norm)
     return train, test

@@ -86,11 +86,21 @@ def state_shardings(mesh: Mesh, state: TrainState, specs=None) -> TrainState:
                         is_leaf=lambda x: isinstance(x, P))
 
 
-def make_loss_fn(model, has_bn: bool):
+def make_loss_fn(model, has_bn: bool, input_norm=None):
     """The per-replica supervised loss shared by the DP and ZeRO steps:
-    cross-entropy + accuracy, BN batch_stats threaded when present."""
+    cross-entropy + accuracy, BN batch_stats threaded when present.
+
+    ``input_norm``: optional (scale[C], shift[C]) applied in-graph
+    (``x * scale - shift``) so the host can ship raw uint8 batches
+    (augment.device_norm_constants) — XLA fuses it into the first conv's
+    input pipeline for free."""
+    if input_norm is not None:
+        scale = jnp.asarray(input_norm[0], jnp.float32)
+        shift = jnp.asarray(input_norm[1], jnp.float32)
 
     def loss_fn(params, bs_local, x, y, rng):
+        if input_norm is not None:
+            x = x * scale - shift
         variables = {"params": params}
         if has_bn:
             variables["batch_stats"] = bs_local
@@ -151,7 +161,8 @@ def fetch_replicated(mesh: Mesh, state: TrainState) -> TrainState:
 
 def make_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
                     state: TrainState, *, sync_batchnorm: bool = False,
-                    remat: bool = False, donate: bool = True) -> Callable:
+                    remat: bool = False, donate: bool = True,
+                    input_norm=None) -> Callable:
     """Build the jitted SPMD train step.
 
     Returns ``step_fn(state, x, y, mask, rng) -> (state, metrics)`` where
@@ -162,7 +173,7 @@ def make_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
     metrics: dict of replicated scalars (loss, accuracy, participating).
     """
     has_bn = bool(jax.tree.leaves(state.batch_stats))
-    loss_fn = make_loss_fn(model, has_bn)
+    loss_fn = make_loss_fn(model, has_bn, input_norm)
     vg = jax.value_and_grad(
         jax.checkpoint(loss_fn) if remat else loss_fn, has_aux=True)
 
@@ -208,14 +219,20 @@ def make_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
     return jax.jit(sharded, donate_argnums=(0,) if donate else ())
 
 
-def make_eval_step(model) -> Callable:
+def make_eval_step(model, input_norm=None) -> Callable:
     """Jitted single-shard eval: (params, batch_stats_local, x, y) ->
     dict(sum_loss, top1, top5, count). The evaluator feeds replica-0 batch
     stats, mirroring the reference evaluator consuming a single worker's
-    checkpoint (``distributed_evaluator.py:90-106``)."""
+    checkpoint (``distributed_evaluator.py:90-106``). ``input_norm`` as in
+    make_loss_fn (raw uint8 batches, in-graph normalize)."""
+    if input_norm is not None:
+        scale = jnp.asarray(input_norm[0], jnp.float32)
+        shift = jnp.asarray(input_norm[1], jnp.float32)
 
     @jax.jit
     def eval_step(params, batch_stats, x, y):
+        if input_norm is not None:
+            x = x * scale - shift
         variables = {"params": params}
         if jax.tree.leaves(batch_stats):
             variables["batch_stats"] = batch_stats

@@ -84,12 +84,13 @@ def zero_state_specs(state: TrainState) -> TrainState:
 
 def make_zero_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
                          state: TrainState, *, sync_batchnorm: bool = False,
-                         remat: bool = False, donate: bool = True) -> Callable:
+                         remat: bool = False, donate: bool = True,
+                         input_norm=None) -> Callable:
     """Same signature/semantics as ``dp.make_train_step`` with the weight
     update sharded across the 'data' axis."""
     has_bn = bool(jax.tree.leaves(state.batch_stats))
     n = mesh.shape["data"]
-    loss_fn = make_loss_fn(model, has_bn)
+    loss_fn = make_loss_fn(model, has_bn, input_norm)
     vg = jax.value_and_grad(
         jax.checkpoint(loss_fn) if remat else loss_fn, has_aux=True)
 

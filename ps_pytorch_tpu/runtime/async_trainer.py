@@ -68,7 +68,10 @@ class AsyncTrainer:
         per = len(devices)
         self._bs = jax.device_get(jax.tree.map(
             lambda a: np.tile(a[None], (per,) + (1,) * a.ndim), bs0))
-        self.grad_fn = make_slice_grad_fn(self.model, self.mesh, self.has_bn)
+        from ps_pytorch_tpu.data.augment import input_norm_for
+        self._input_norm = input_norm_for(cfg)
+        self.grad_fn = make_slice_grad_fn(self.model, self.mesh, self.has_bn,
+                                          self._input_norm)
 
         if kv is None:
             kv = DistributedKV() if self.n > 1 else KVStore()
@@ -96,16 +99,19 @@ class AsyncTrainer:
         # Per-slice data: this process is shard pid-of-n over the shared-seed
         # shuffle; each slice draws cfg.batch_size per step like a reference
         # worker.
+        dev_norm = self._input_norm is not None
         xtr, ytr = load_arrays(cfg.dataset, cfg.data_dir, train=True,
                                seed=cfg.seed)
         self.train_loader = DataLoader(
             xtr, ytr, cfg.batch_size * self.n, cfg.dataset, train=True,
-            seed=cfg.seed, host_id=self.pid, num_hosts=self.n)
+            seed=cfg.seed, host_id=self.pid, num_hosts=self.n,
+            device_normalize=dev_norm)
         xte, yte = load_arrays(cfg.dataset, cfg.data_dir, train=False,
                                seed=cfg.seed)
         self.test_loader = DataLoader(xte, yte, cfg.test_batch_size,
                                       cfg.dataset, train=False, shuffle=False,
-                                      seed=cfg.seed, drop_last=False)
+                                      seed=cfg.seed, drop_last=False,
+                                      device_normalize=dev_norm)
 
         self.metrics = MetricsLogger(cfg.metrics_file, cfg.log_every)
         self.version = 0        # canonical PS step (leader-owned)
@@ -295,5 +301,6 @@ class AsyncTrainer:
         else:
             params, bs0 = self.params, self._bs0()
         from ps_pytorch_tpu.runtime.evaluator import accumulate_eval
-        return accumulate_eval(make_eval_step(self.model), params, bs0,
+        return accumulate_eval(make_eval_step(self.model, self._input_norm),
+                               params, bs0,
                                self.test_loader.epoch(0), max_batches)

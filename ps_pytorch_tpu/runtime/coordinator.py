@@ -108,6 +108,7 @@ class Coordinator:
         self.run_id = run_id
         self.leader = leader
         self.mask_gc_window = max(int(mask_gc_window), 2)
+        self._last_printed_mask: Optional[str] = None
         # last observed per-replica step duration (telemetry; seconds)
         self._last_duration = np.zeros(n_replicas, np.float64)
         self._killed = np.zeros(n_replicas, bool)
@@ -166,6 +167,12 @@ class Coordinator:
                     raise TimeoutError(f"no mask published for step {step}")
                 time.sleep(0.002)
         mask = self._decide_mask()
+        # Observability: one stable line whenever the decision changes (the
+        # reference's only straggler evidence was per-worker timing logs).
+        desc = json.dumps(mask.astype(int).tolist())
+        if desc != self._last_printed_mask:
+            print(f"MASK step {step} {desc}")
+            self._last_printed_mask = desc
         self.kv.set(key, json.dumps(mask.tolist()))
         # GC with a WIDE window, not step-2: JAX dispatch is async and
         # followers only synchronize when metrics materialize (log_every), so

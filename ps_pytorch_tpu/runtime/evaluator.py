@@ -64,7 +64,8 @@ class Evaluator:
             self.model, build_optimizer(cfg), mesh,
             (1,) + sample_shape(cfg.dataset), jax.random.key(0))
         _, self.test_loader = prepare_data(cfg, download=self.download)
-        self.eval_fn = make_eval_step(self.model)
+        from ps_pytorch_tpu.data.augment import input_norm_for
+        self.eval_fn = make_eval_step(self.model, input_norm_for(cfg))
         self._built_for = config_json
 
     def evaluate_step(self, step: int) -> dict:

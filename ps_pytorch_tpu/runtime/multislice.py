@@ -42,11 +42,12 @@ from ps_pytorch_tpu.parallel.mesh import make_mesh
 from ps_pytorch_tpu.runtime.metrics import MetricsLogger
 
 
-def make_slice_grad_fn(model, mesh: Mesh, has_bn: bool):
+def make_slice_grad_fn(model, mesh: Mesh, has_bn: bool, input_norm=None):
     """Jitted per-slice gradient: (params, bs, x, y, rng) ->
     (psum-averaged grads, metrics, new_bs). Params replicated within the
-    slice; batch sharded over its 'data' axis."""
-    loss_fn = make_loss_fn(model, has_bn)
+    slice; batch sharded over its 'data' axis. ``input_norm`` as in
+    dp.make_loss_fn (raw uint8 batches, in-graph normalize)."""
+    loss_fn = make_loss_fn(model, has_bn, input_norm)
     vg = jax.value_and_grad(loss_fn, has_aux=True)
 
     def local(params, bs, x, y, rng):
@@ -108,7 +109,10 @@ class MultiSliceTrainer:
             staleness_decay=cfg.staleness_decay,
             num_aggregate=cfg.num_aggregate, compress=cfg.compress_grad,
             codec=cfg.grad_codec, codec_level=cfg.codec_level)
-        self.grad_fns = [make_slice_grad_fn(self.model, m, self.has_bn)
+        from ps_pytorch_tpu.data.augment import input_norm_for
+        self._input_norm = input_norm_for(cfg)
+        self.grad_fns = [make_slice_grad_fn(self.model, m, self.has_bn,
+                                            self._input_norm)
                          for m in self.meshes]
         # Each slice's last-fetched parameter copy and its version step.
         self._slice_params = [self.params] * n_slices
@@ -124,18 +128,20 @@ class MultiSliceTrainer:
         # scheduling. Each slice still draws cfg.batch_size per step, like a
         # reference worker (hence the n_slices-scaled loader batch).
         from ps_pytorch_tpu.data.datasets import DataLoader, load_arrays
+        dev_norm = self._input_norm is not None
         xtr, ytr = load_arrays(cfg.dataset, cfg.data_dir, train=True,
                                seed=cfg.seed)
         self.train_loaders = [
             DataLoader(xtr, ytr, cfg.batch_size * n_slices, cfg.dataset,
                        train=True, seed=cfg.seed, host_id=s,
-                       num_hosts=n_slices)
+                       num_hosts=n_slices, device_normalize=dev_norm)
             for s in range(n_slices)]
         xte, yte = load_arrays(cfg.dataset, cfg.data_dir, train=False,
                                seed=cfg.seed)
         self.test_loader = DataLoader(xte, yte, cfg.test_batch_size,
                                       cfg.dataset, train=False, shuffle=False,
-                                      seed=cfg.seed, drop_last=False)
+                                      seed=cfg.seed, drop_last=False,
+                                      device_normalize=dev_norm)
         self.metrics = MetricsLogger(cfg.metrics_file, cfg.log_every)
         self.step = 0          # canonical (master) step
         self.applied = 0       # updates actually applied
@@ -189,7 +195,8 @@ class MultiSliceTrainer:
         the reference evaluator consuming one worker's checkpoint)."""
         from ps_pytorch_tpu.parallel.dp import make_eval_step
         from ps_pytorch_tpu.runtime.evaluator import accumulate_eval
-        return accumulate_eval(make_eval_step(self.model), self.params,
+        return accumulate_eval(make_eval_step(self.model, self._input_norm),
+                               self.params,
                                jax.tree.map(lambda a: a[0], self._bs[0]),
                                self.test_loader.epoch(0), max_batches)
 

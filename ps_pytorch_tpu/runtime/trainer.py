@@ -51,6 +51,8 @@ class Trainer:
         self.train_loader, self.test_loader = prepare_data(
             cfg, host_id=host_id, num_hosts=num_hosts, download=download)
         sample = (1,) + sample_shape(cfg.dataset)
+        from ps_pytorch_tpu.data.augment import input_norm_for
+        input_norm = input_norm_for(cfg)
         if cfg.shard_update:
             from ps_pytorch_tpu.parallel.zero import (
                 create_zero_train_state, make_zero_train_step, zero_state_specs,
@@ -60,7 +62,7 @@ class Trainer:
             self.step_fn = make_zero_train_step(
                 self.model, self.tx, self.mesh, self.state,
                 sync_batchnorm=cfg.sync_batchnorm, remat=cfg.remat,
-                donate=cfg.donate)
+                donate=cfg.donate, input_norm=input_norm)
             self._state_specs = zero_state_specs
         else:
             self.state = create_train_state(self.model, self.tx, self.mesh,
@@ -68,10 +70,11 @@ class Trainer:
             self.step_fn = make_train_step(self.model, self.tx, self.mesh,
                                            self.state,
                                            sync_batchnorm=cfg.sync_batchnorm,
-                                           remat=cfg.remat, donate=cfg.donate)
+                                           remat=cfg.remat, donate=cfg.donate,
+                                           input_norm=input_norm)
             from ps_pytorch_tpu.parallel.dp import state_specs
             self._state_specs = state_specs
-        self.eval_fn = make_eval_step(self.model)
+        self.eval_fn = make_eval_step(self.model, input_norm)
         if coordinator is None:
             kv = None
             if dist.is_multiprocess():
@@ -139,6 +142,7 @@ class Trainer:
         epoch_budget = cfg.epochs * steps_per_epoch if cfg.epochs > 0 else cfg.max_steps
         last_step = min(cfg.max_steps, epoch_budget)
         step = self.start_step
+        m_prev = None
         while step < last_step:
             step += 1
             if self._profile_range:
@@ -167,8 +171,26 @@ class Trainer:
                 dist.globalize_replicated(self.mesh, np.asarray(mask, np.float32)),
                 dist.globalize_replicated(self.mesh, key, spec=jax.sharding.PartitionSpec()))
             self.state = new_state
+            if cfg.inject_step_delay > 0 and \
+                    jax.process_index() == cfg.inject_delay_process:
+                # Fault injection (tests/ops drills): make THIS host a
+                # straggler. The reference had no fault injection at all
+                # (SURVEY §5.3); its stragglers were organic EC2 noise.
+                time.sleep(cfg.inject_step_delay)
+            # 1-deep pipeline: completing step-1 before dispatching step+1
+            # keeps device/host overlap while making the per-iteration wall
+            # time a TRUE per-step duration — reported EVERY step, so the
+            # kofn/deadline policies never act on stale numbers (the round-1
+            # telemetry was gated on log_every; the reference timed every
+            # worker step, distributed_worker.py:169-173).
+            if m_prev is not None:
+                _ = float(m_prev["loss"])
+            m_prev = m
+            t_step = time.monotonic() - t0
+            for r in self._local_replicas:
+                self.coordinator.report_duration(r, step, t_step)
             if step % cfg.log_every == 0 or step == last_step:
-                # Materializing metrics syncs the device; skip between logs.
+                # Materializing metrics fully syncs the device.
                 loss = float(m["loss"])
                 acc = float(m["accuracy"])
                 part = float(m["participating"])
@@ -177,8 +199,6 @@ class Trainer:
                 self.metrics.log_step(step, epoch, loss=loss, acc=acc,
                                       participating=part, step_time=t_step,
                                       data_time=t_data)
-                for r in self._local_replicas:
-                    self.coordinator.report_duration(r, step, t_step)
             if cfg.eval_freq > 0 and step % cfg.eval_freq == 0:
                 self._checkpoint(step)
         jax.block_until_ready(self.state.params)

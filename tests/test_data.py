@@ -2,6 +2,7 @@
 README.md:24), augmentation shapes/determinism, persistent next_batch."""
 
 import numpy as np
+import pytest
 
 from ps_pytorch_tpu.config import TrainConfig
 from ps_pytorch_tpu.data import DataLoader, prepare_data
@@ -41,6 +42,94 @@ def test_random_crop_reflect_identity_possible(rng):
     x = rng.random((4, 8, 8, 1), dtype=np.float32)
     out = random_crop(x, np.random.default_rng(0), pad=2, mode="reflect")
     assert out.shape == x.shape
+
+
+def test_random_crop_vectorized_matches_loop(rng):
+    """The batched-gather crop must be bit-identical to a per-image loop
+    with the same rng draws (same ys-then-xs order)."""
+    x = rng.random((16, 32, 32, 3)).astype(np.float32)
+    for mode in ("reflect", "constant"):
+        out = random_crop(x, np.random.default_rng(7), pad=4, mode=mode)
+        # Reference loop with identical draw order.
+        r2 = np.random.default_rng(7)
+        padded = np.pad(x, ((0, 0), (4, 4), (4, 4), (0, 0)), mode=mode)
+        ys = r2.integers(0, 9, size=16)
+        xs = r2.integers(0, 9, size=16)
+        want = np.stack([padded[i, ys[i]:ys[i] + 32, xs[i]:xs[i] + 32]
+                         for i in range(16)])
+        np.testing.assert_array_equal(out, want)
+
+
+def test_loader_throughput_probe():
+    """bench_suite's loader-only bench runs and reports a positive rate."""
+    import bench_suite
+    r = bench_suite.bench_input_pipeline("input_pipeline", "synthetic", 64,
+                                         steps=5)
+    assert r["loader_images_per_sec"] > 0
+
+
+def test_uint8_normalize_matches_float_path():
+    """normalize() uint8 fast path == float path to float32 rounding."""
+    from ps_pytorch_tpu.data.augment import CIFAR_MEAN, CIFAR_STD, normalize
+    xu = np.random.default_rng(0).integers(0, 256, (8, 32, 32, 3)).astype(np.uint8)
+    a = normalize(xu, CIFAR_MEAN, CIFAR_STD)
+    b = normalize(xu.astype(np.float32) / 255.0, CIFAR_MEAN, CIFAR_STD)
+    assert np.allclose(a, b, atol=2e-6)
+
+
+def test_device_normalize_loader_emits_uint8():
+    """cfg.device_normalize (default True): loaders ship raw uint8; the
+    in-graph constants reproduce the host normalize exactly."""
+    from ps_pytorch_tpu.data.augment import device_norm_constants, normalize
+    cfg = TrainConfig(dataset="synthetic_cifar10", batch_size=32,
+                      test_batch_size=32)
+    assert cfg.device_normalize
+    train, test = prepare_data(cfg)
+    xb, _ = next(train.epoch(0))
+    assert xb.dtype == np.uint8
+    xt, _ = next(test.epoch(0))
+    assert xt.dtype == np.uint8
+    scale, shift = device_norm_constants(cfg.dataset)
+    from ps_pytorch_tpu.data.augment import CIFAR_MEAN, CIFAR_STD
+    np.testing.assert_allclose(xt * scale - shift,
+                               normalize(xt, CIFAR_MEAN, CIFAR_STD),
+                               atol=1e-6)
+
+
+def test_device_normalize_step_equivalence(mesh8):
+    """A train step on raw uint8 with input_norm == the same step on
+    host-normalized float input (same weights, same rng)."""
+    import jax
+    from ps_pytorch_tpu.data.augment import (
+        CIFAR_MEAN, CIFAR_STD, device_norm_constants, normalize,
+    )
+    from ps_pytorch_tpu.models import build_model
+    from ps_pytorch_tpu.optim import build_optimizer
+    from ps_pytorch_tpu.parallel import create_train_state, make_train_step
+
+    cfg = TrainConfig(dataset="synthetic_cifar10", network="LeNet",
+                      batch_size=64, lr=0.05, compute_dtype="float32",
+                      num_classes=10)
+    model = build_model("LeNet", 10, "float32")
+    rng = np.random.default_rng(0)
+    xu = rng.integers(0, 256, (64, 32, 32, 3)).astype(np.uint8)
+    y = rng.integers(0, 10, 64).astype(np.int32)
+    mask = np.ones(8, np.float32)
+    key = jax.random.PRNGKey(1)
+
+    losses = {}
+    for name, norm, x in [
+        ("device", device_norm_constants(cfg.dataset), xu),
+        ("host", None, normalize(xu, CIFAR_MEAN, CIFAR_STD)),
+    ]:
+        tx = build_optimizer(cfg)
+        state = create_train_state(model, tx, mesh8, (1, 32, 32, 3),
+                                   jax.random.key(0))
+        step = make_train_step(model, tx, mesh8, state, donate=False,
+                               input_norm=norm)
+        _, m = step(state, np.asarray(x), y, mask, key)
+        losses[name] = float(m["loss"])
+    assert losses["device"] == pytest.approx(losses["host"], abs=1e-5)
 
 
 def test_mnist_normalize_matches_reference():
