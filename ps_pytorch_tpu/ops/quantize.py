@@ -56,6 +56,23 @@ def _quant_kernel(s_ref, x_ref, u_ref, v_ref):
 
 
 @partial(jax.jit, static_argnames=("interpret",))
+def _quantize_full(x, key, interpret):
+    """Whole quantize path (ravel+pad+noise+absmax+kernel) as ONE program.
+
+    Keeping the prep ops inside the jit matters on real hardware: executed
+    eagerly they cost ~16 ms/64 MiB in dispatch+materialisation where the
+    fused program takes ~0.09 ms (measured on v5e).
+    """
+    size = x.size if x.shape else 1
+    flat = jnp.ravel(x).astype(jnp.float32)
+    rows = -(-max(size, 1) // BLOCK) * BLOCK_ROWS
+    pad = rows * LANES - size
+    x2d = jnp.pad(flat, (0, pad)).reshape(rows, LANES)
+    noise = jax.random.uniform(key, (rows, LANES), jnp.float32)
+    return _quantize_padded(x2d, noise, interpret)
+
+
+@partial(jax.jit, static_argnames=("interpret",))
 def _quantize_padded(x2d, noise, interpret):
     nblk = x2d.shape[0] // BLOCK_ROWS
     amax = jnp.max(jnp.abs(x2d.reshape(nblk, BLOCK)), axis=1)
@@ -85,12 +102,7 @@ def quantize_int8(x: jax.Array, key: jax.Array,
         interpret = _interpret_default()
     shape = tuple(x.shape)
     size = int(np.prod(shape)) if shape else 1
-    flat = jnp.ravel(x).astype(jnp.float32)
-    rows = -(-max(size, 1) // BLOCK) * BLOCK_ROWS
-    pad = rows * LANES - size
-    x2d = jnp.pad(flat, (0, pad)).reshape(rows, LANES)
-    noise = jax.random.uniform(key, (rows, LANES), jnp.float32)
-    values, scales = _quantize_padded(x2d, noise, interpret)
+    values, scales = _quantize_full(x, key, interpret)
     return QuantizedTensor(values=values, scales=scales, shape=shape, size=size)
 
 

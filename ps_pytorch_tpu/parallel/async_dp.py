@@ -29,6 +29,21 @@ import jax
 import numpy as np
 
 
+def colocate_like(leaf, ref):
+    """Move a device leaf onto ``ref``'s placement (no-op for numpy or
+    already-colocated arrays). The transfer is the cross-slice hop — ICI
+    device-to-device on hardware, never via the host."""
+    if (isinstance(leaf, jax.Array) and isinstance(ref, jax.Array)
+            and leaf.sharding != ref.sharding):
+        return jax.device_put(leaf, ref.sharding)
+    return leaf
+
+
+def colocate_tree(tree, ref_tree):
+    """Tree-mapped :func:`colocate_like`."""
+    return jax.tree.map(colocate_like, tree, ref_tree)
+
+
 class StaleGradientAggregator:
     def __init__(self, n_slices: int, staleness_limit: int = 4,
                  staleness_decay: float = 0.0, num_aggregate: int = 0,
@@ -67,11 +82,14 @@ class StaleGradientAggregator:
             key = jax.random.key((hash((slice_id, step)) & 0x7FFFFFFF))
             leaves = [quantize_int8(l, jax.random.fold_in(key, i))
                       for i, l in enumerate(leaves)]
-        else:
-            leaves = [np.asarray(l) for l in leaves]
-            if self.compress:
-                from ps_pytorch_tpu.compression import g_compress
-                leaves = [g_compress(l, level=self.codec_level) for l in leaves]
+        elif self.compress:
+            from ps_pytorch_tpu.compression import g_compress
+            leaves = [g_compress(np.asarray(l), level=self.codec_level)
+                      for l in leaves]
+        # No codec: pool leaves as submitted. In-process callers hand device
+        # arrays, which STAY on device (collect's arithmetic then runs there
+        # and the averaged gradient never round-trips the host); wire callers
+        # hand numpy that was already pulled for decode.
         self._pool[slice_id] = (step, leaves, treedef)
 
     def wire_bytes(self) -> int:
@@ -87,6 +105,7 @@ class StaleGradientAggregator:
                 else:
                     total += l.nbytes
         return total
+
 
     def collect(self, current_step: int) -> Tuple[Optional[Any], dict]:
         """-> (weighted-average gradient pytree or None, info).
@@ -120,11 +139,18 @@ class StaleGradientAggregator:
             elif self.compress:
                 from ps_pytorch_tpu.compression import g_decompress
                 leaves = [g_decompress(l) for l in leaves]
+            # Functional accumulation: works identically for numpy leaves
+            # (wire path) and device-resident jax leaves (in-process path —
+            # where an in-place += would silently rebind, not accumulate).
+            # Device leaves from different slices live on different device
+            # groups; the device_put onto the accumulator's placement IS the
+            # cross-slice hop (ICI device-to-device on real hardware, never
+            # via the host).
             if acc is None:
                 acc = [w * l.astype(np.float32) for l in leaves]
             else:
-                for a, l in zip(acc, leaves):
-                    a += w * l.astype(np.float32)
+                acc = [a + w * colocate_like(l, a).astype(np.float32)
+                       for a, l in zip(acc, leaves)]
             wsum += w
         avg = [a / wsum for a in acc]
         info = {"used": [sid for _, sid, _, _ in fresh],

@@ -54,6 +54,11 @@ class AsyncTrainer:
         self.leader = self.pid == 0
         devices = jax.local_devices()
         self.mesh = make_mesh(data=len(devices), devices=devices)
+        from jax.sharding import NamedSharding, PartitionSpec as _P
+        # Canonical placement for params fetched/restored from the wire:
+        # replicated over THIS process's local mesh (uncommitted arrays work
+        # too, but explicit placement keeps every path uniform).
+        self._rep = NamedSharding(self.mesh, _P())
         self.model = build_model(cfg.network, cfg.num_classes, cfg.compute_dtype)
         self.tx = build_optimizer(cfg)
 
@@ -62,12 +67,17 @@ class AsyncTrainer:
                                     jnp.zeros(shape, jnp.float32), train=False)
         # Same seed everywhere -> every process starts from identical weights
         # (the reference broadcasts initial weights; here the bcast is free).
-        self.params = jax.device_get(variables["params"])
+        # Canonical params/opt state/BN stats live ON DEVICE for the whole
+        # run — the wire boundary (device_get/put) is crossed only at
+        # publish/fetch/submit, never per local step. The reference master
+        # updated host-side numpy every step (sync_replicas_master_nn.py:
+        # 204-208); keeping residency is the TPU-first inversion of that.
+        self.params = variables["params"]
         self.has_bn = "batch_stats" in variables
         bs0 = variables.get("batch_stats", {})
         per = len(devices)
-        self._bs = jax.device_get(jax.tree.map(
-            lambda a: np.tile(a[None], (per,) + (1,) * a.ndim), bs0))
+        self._bs = jax.tree.map(
+            lambda a: jnp.tile(a[None], (per,) + (1,) * a.ndim), bs0)
         from ps_pytorch_tpu.data.augment import input_norm_for
         self._input_norm = input_norm_for(cfg)
         self.grad_fn = make_slice_grad_fn(self.model, self.mesh, self.has_bn,
@@ -85,6 +95,9 @@ class AsyncTrainer:
         grad_template = self.params if not self._wire_int8 else \
             jax.tree.map(lambda a: {"v": np.zeros(0, np.int8),
                                     "s": np.zeros(0, np.float32)}, self.params)
+        # Shape/size reference for wire decode (structure only, no storage).
+        self._param_tpl = jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), self.params)
         # Canonical publish carries params AND the leader's replica-0 BN
         # stats, so every process evaluates identical state (the reference
         # evaluator scores the master's checkpoint, which includes whatever
@@ -114,19 +127,27 @@ class AsyncTrainer:
                                       device_normalize=dev_norm)
 
         self.metrics = MetricsLogger(cfg.metrics_file, cfg.log_every)
+        self.last_publish_s = 0.0
         self.version = 0        # canonical PS step (leader-owned)
         self.applied = 0
         self.dropped_stale = 0
         self._seq = 0
         if self.leader:
-            self.opt_state = jax.device_get(self.tx.init(variables["params"]))
+            self.opt_state = self.tx.init(variables["params"])
             self.aggregator = StaleGradientAggregator(
                 self.n, staleness_limit=cfg.staleness_limit,
                 staleness_decay=cfg.staleness_decay,
                 num_aggregate=cfg.num_aggregate,
                 compress=False)  # the WIRE is compressed; the pool is local
+            # out_shardings pins the updated params/opt state REPLICATED
+            # over the local mesh: a bare jit would commit them to one
+            # device, and the next multi-device shard_map grad_fn call
+            # would fail with incompatible devices (single-device CI can't
+            # see this; multislice.py handles the same hazard).
+            rep = self._rep
             self._update = jax.jit(
-                lambda p, o, g: apply_optimizer(self.tx, p, o, g))
+                lambda p, o, g: apply_optimizer(self.tx, p, o, g),
+                out_shardings=(rep, rep))
 
     # ---- checkpoint/resume (leader authority, sync-Trainer contract) ----
     def _as_train_state(self):
@@ -148,8 +169,10 @@ class AsyncTrainer:
             return False
         state, meta, _ = ckpt.load_checkpoint(
             self.cfg.train_dir, step, jax.device_get(self._as_train_state()))
-        self.params, self.opt_state = state.params, state.opt_state
-        self._bs = state.batch_stats
+        # Checkpoints come back as host numpy; restore device residency once.
+        self.params = jax.device_put(state.params, self._rep)
+        self.opt_state = jax.device_put(state.opt_state, self._rep)
+        self._bs = jax.device_put(state.batch_stats)
         self.version = int(meta["step"])
         print(f"RESUME from {ckpt.checkpoint_path(self.cfg.train_dir, step)} "
               f"at step {self.version}")
@@ -184,15 +207,17 @@ class AsyncTrainer:
         # template for shape/size by walking the flattened orders.
         wire_leaves = jax.tree.flatten(
             wire, is_leaf=lambda x: isinstance(x, dict) and "v" in x)[0]
-        tpl_leaves, treedef = jax.tree.flatten(self.params)
+        tpl_leaves, treedef = jax.tree.flatten(self._param_tpl)
         return jax.tree.unflatten(
             treedef, [leaf(e, t) for e, t in zip(wire_leaves, tpl_leaves)])
 
     # ---- the two roles ----
     def _publish_canonical(self) -> None:
+        t0 = time.monotonic()
         self.transport.publish_params(
             self.version, {"params": jax.device_get(self.params),
                            "bs0": jax.device_get(self._bs0())})
+        self.last_publish_s = time.monotonic() - t0
 
     def _compute_and_submit(self, version_used: int) -> dict:
         x, y = self.train_loader.next_batch()
@@ -214,8 +239,10 @@ class AsyncTrainer:
         avg, pool = self.aggregator.collect(self.version)
         used = 0
         if avg is not None and pool["used"]:
-            self.params, self.opt_state = jax.device_get(self._update(
-                self.params, self.opt_state, avg))
+            # Update runs jitted with everything already device-resident;
+            # only the pooled average crosses host->device here.
+            self.params, self.opt_state = self._update(
+                self.params, self.opt_state, avg)
             self.version += 1
             self.applied += 1
             used = len(pool["used"])
@@ -243,7 +270,7 @@ class AsyncTrainer:
                 got = self.transport.fetch_params()
                 if got is not None:
                     my_version, tree = got
-                    self.params = tree["params"]
+                    self.params = jax.device_put(tree["params"], self._rep)
                     break
                 if time.monotonic() > deadline:
                     raise TimeoutError("no initial params from leader")
@@ -268,17 +295,24 @@ class AsyncTrainer:
                 got = self.transport.fetch_params()
                 if got is not None and got[0] > my_version:
                     my_version, tree = got
-                    self.params = tree["params"]
+                    # ONE host->device transfer per fetch; the jitted grad fn
+                    # then reuses the device copy every local step (feeding
+                    # numpy would re-transfer the full model each call).
+                    self.params = jax.device_put(tree["params"], self._rep)
             m = self._compute_and_submit(my_version)
             own_steps += 1
             used = self._leader_apply() if self.leader else 0
             step_for_log = self.version if self.leader else own_steps
             if step_for_log and step_for_log % cfg.log_every == 0:
+                wire = self.transport.wire_stats()
                 self.metrics.log_step(
                     step_for_log, 0, loss=m["loss"], acc=m["acc"],
                     participating=float(used),
                     step_time=time.monotonic() - t0, data_time=0.0,
-                    applied=self.applied, dropped_stale=self.dropped_stale)
+                    applied=self.applied, dropped_stale=self.dropped_stale,
+                    wire_bytes_out=wire["wire_bytes_out"],
+                    wire_bytes_in=wire["wire_bytes_in"],
+                    publish_s=round(self.last_publish_s, 4))
         if self.leader:
             if cfg.eval_freq > 0 and self.version % cfg.eval_freq != 0:
                 self._checkpoint()

@@ -135,6 +135,16 @@ class Coordinator:
 
     # ---- telemetry ----
     def report_duration(self, replica: int, step: int, seconds: float) -> None:
+        """Record a replica's last true step duration.
+
+        Granularity contract: durations are HOST wall times — a host reports
+        the same value for every replica it owns, because replicas within an
+        SPMD host step in lockstep (there is no meaningful per-device step
+        time to observe; the program is one dispatch). Stragglers are
+        host-level events (preemption, network, thermal), which is also what
+        the reference's per-worker timers measured (distributed_worker.py:
+        169-173 — one process per worker = one clock per "host").
+        Consequence for kofn: see _decide_mask."""
         self._last_duration[replica] = seconds
         self.kv.set(f"{self.run_id}/dur/{replica}", json.dumps([step, seconds]))
 
@@ -193,7 +203,13 @@ class Coordinator:
             mask *= (dur <= self.kill_threshold).astype(np.float32)
         if self.mode == "kofn" and self.k < self.n:
             # Fastest-K by last observed duration ~ "first K gradient
-            # arrivals" (sync_replicas_master_nn.py:179). Ties -> lower index.
+            # arrivals" (sync_replicas_master_nn.py:179). Durations are
+            # host-granular (see report_duration), so selection is sharp
+            # BETWEEN hosts and degenerates to the stable-sort tiebreak
+            # (lower replica index first) WITHIN a host — i.e. K-of-N drops
+            # slow HOSTS' replicas first, then lowest-indexed replicas of
+            # the boundary host. That is the right cut on real hardware:
+            # within-host replicas finish together by construction.
             alive = np.nonzero(mask > 0)[0]
             if len(alive) > self.k:
                 keep = alive[np.argsort(dur[alive], kind="stable")[:self.k]]

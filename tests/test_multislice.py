@@ -59,6 +59,20 @@ def test_slow_slice_submits_stale_but_fresh_enough():
     assert t.applied == 8
 
 
+def test_update_when_slice0_not_contributing():
+    """Regression (r3 review): with slice 0 SLOW (periods=[2,1]), tick 2's
+    pool holds only slice 1's gradient, which lives on slice 1's devices —
+    the canonical update must realign it to the canonical params' placement
+    instead of failing with incompatible devices."""
+    from ps_pytorch_tpu.runtime.multislice import MultiSliceTrainer
+
+    t = MultiSliceTrainer(_cfg(), n_slices=2, slice_periods=[2, 1])
+    t.tick()                      # both compute (step 1)
+    info = t.tick()               # only slice 1 computes and is pooled
+    assert info["computed"] == [1]
+    assert t.applied == 2         # the slice-1-only update applied fine
+
+
 def test_too_stale_contributions_dropped():
     """staleness_limit=0 + a slice that only fetches every 4 steps: its
     stale gradients must be dropped, and training continues on the rest."""

@@ -66,6 +66,31 @@ def test_coordinator_kofn_fastest_k():
     np.testing.assert_array_equal(mask, [0, 1, 0, 1])
 
 
+def test_coordinator_kofn_host_granular_durations():
+    """The documented duration-granularity contract (report_duration /
+    _decide_mask): durations are host wall times, so K-of-N selection is
+    sharp BETWEEN hosts and falls back to the stable lowest-index-first
+    tiebreak WITHIN a host reporting identical times."""
+    # 2 hosts x 2 replicas: host A (replicas 0,1) slow, host B (2,3) fast.
+    c = Coordinator(4, mode="kofn", num_aggregate=2)
+    for r, d in zip(range(4), [0.9, 0.9, 0.1, 0.1]):
+        c.report_duration(r, 1, d)
+    # Between hosts: the fast host's replicas win outright.
+    np.testing.assert_array_equal(c.participation_mask(2), [0, 0, 1, 1])
+    # Within a host (all four report one identical host time): selection
+    # degenerates to lowest replica index first — deterministic, documented.
+    c2 = Coordinator(4, mode="kofn", num_aggregate=3)
+    for r in range(4):
+        c2.report_duration(r, 1, 0.5)
+    np.testing.assert_array_equal(c2.participation_mask(2), [1, 1, 1, 0])
+    # Boundary host: fast host fully in, remainder of K comes from the slow
+    # host's lowest indices.
+    c3 = Coordinator(4, mode="kofn", num_aggregate=3)
+    for r, d in zip(range(4), [0.9, 0.9, 0.1, 0.1]):
+        c3.report_duration(r, 1, d)
+    np.testing.assert_array_equal(c3.participation_mask(2), [1, 0, 1, 1])
+
+
 def test_coordinator_deadline_and_kill():
     c = Coordinator(3, mode="kofn", num_aggregate=3, kill_threshold=1.0)
     for r, d in enumerate([0.5, 2.0, 0.7]):

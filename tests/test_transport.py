@@ -53,17 +53,17 @@ def test_channel_gc_keeps_reader_window():
     assert kv.get("t/ch/0/0/0") is None
 
 
-def test_wire_is_compressed_base64():
+def test_wire_is_compressed_base85():
     """The bytes on the KV must be the codec's output (the reference's
-    --compress-grad semantics, compression.py:18-45), base64-encoded —
-    not raw floats."""
+    --compress-grad semantics, compression.py:18-45), base85-armoured
+    (25% overhead vs base64's 33%) — not raw floats."""
     kv = KVStore()
     # Compressible payload: constant array.
     t = {"w": np.zeros((256, 256), np.float32)}
     ch = KVPytreeChannel(kv, "t/ch", t)
     ch.publish(1, t)
     payload = kv.get("t/ch/1/0/0")
-    raw = base64.b64decode(payload.encode("ascii"))
+    raw = base64.b85decode(payload.encode("ascii"))
     assert len(raw) < t["w"].nbytes / 10  # codec actually compressed
     from ps_pytorch_tpu.compression import g_decompress
     np.testing.assert_array_equal(g_decompress(raw), t["w"])
