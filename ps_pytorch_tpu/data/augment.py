@@ -127,7 +127,9 @@ CROP_STACKS = {
 
 def norm_constants_for(dataset: str):
     """(mean, std) of the host normalize stack, or None."""
-    if dataset == "MNIST":
+    if dataset in ("MNIST", "Digits"):
+        # Digits reuses MNIST's constants: same geometry/pipeline, and the
+        # normalize is an affine preprocessing choice, not a dataset fact.
         return MNIST_MEAN, MNIST_STD
     if dataset in ("Cifar10", "Cifar100", "synthetic_cifar10"):
         return CIFAR_MEAN, CIFAR_STD

@@ -29,6 +29,10 @@ from ps_pytorch_tpu.data import augment
 # dataset -> (H, W, C, num_classes, train_size_hint)
 DATASET_SHAPES = {
     "MNIST": (28, 28, 1, 10, 60000),
+    # Real handwritten-digit scans bundled with scikit-learn (UCI digits),
+    # upsampled to MNIST geometry — the real-data accuracy oracle for
+    # zero-egress environments (data/vision_io.load_digits28).
+    "Digits": (28, 28, 1, 10, 1437),
     "Cifar10": (32, 32, 3, 10, 50000),
     "Cifar100": (32, 32, 3, 100, 50000),
     "SVHN": (32, 32, 3, 10, 73257),
@@ -54,26 +58,26 @@ def sample_shape(dataset: str) -> Tuple[int, int, int]:
     return (h, w, c)
 
 
-def _load_torchvision(name: str, root: str, train: bool, download: bool):
-    from torchvision import datasets  # local import: torch is heavy
+def _load_files(name: str, root: str, train: bool, download: bool):
+    """Load a real dataset from its standard on-disk files (data/vision_io
+    parsers — torchvision is not a dependency). ``download=True`` fetches
+    the files first via tools/data_prepare's mirror list; training never
+    downloads (reference util.py keeps download=False for workers)."""
+    from ps_pytorch_tpu.data import vision_io
 
+    if download and name != "Digits":
+        from ps_pytorch_tpu.tools.data_prepare import ensure_downloaded
+        ensure_downloaded(name, root)
     if name == "MNIST":
-        ds = datasets.MNIST(root, train=train, download=download)
-        x = ds.data.numpy()[..., None]            # [N,28,28,1] uint8
-        y = ds.targets.numpy()
+        x, y = vision_io.load_mnist(root, train)
     elif name == "Cifar10":
-        ds = datasets.CIFAR10(root, train=train, download=download)
-        x = ds.data                                # [N,32,32,3] uint8 NHWC
-        y = np.asarray(ds.targets)
+        x, y = vision_io.load_cifar10(root, train)
     elif name == "Cifar100":
-        ds = datasets.CIFAR100(root, train=train, download=download)
-        x = ds.data
-        y = np.asarray(ds.targets)
+        x, y = vision_io.load_cifar100(root, train)
     elif name == "SVHN":
-        ds = datasets.SVHN(root, split="train" if train else "test",
-                           download=download)
-        x = ds.data.transpose(0, 2, 3, 1)          # NCHW -> NHWC
-        y = ds.labels
+        x, y = vision_io.load_svhn(root, train)
+    elif name == "Digits":
+        x, y = vision_io.load_digits28(train)
     else:
         raise ValueError(name)
     # Keep raw uint8: 4x fewer bytes through the shuffle/pad/crop hot path;
@@ -105,7 +109,7 @@ def load_arrays(dataset: str, data_dir: str = "./data", train: bool = True,
     """-> (x [N,H,W,C] float32 in [0,1], y [N] int32), unnormalized."""
     if dataset.startswith("synthetic"):
         return _synthetic(dataset, train, seed)
-    return _load_torchvision(dataset, data_dir, train, download)
+    return _load_files(dataset, data_dir, train, download)
 
 
 # Shared pre-padded stores: multi-slice/async trainers build one DataLoader

@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""Real-dataset time-to-accuracy harness.
+
+Drives the REAL contract end to end — ``train.py`` writes checkpoints,
+``evaluate.py --once`` scores the final ``model_step_<k>`` — and records
+steps, wall-clock, and Prec@1/Prec@5 into a JSON artifact. This is the
+framework's analogue of the reference's accuracy oracle (the standalone
+evaluator scoring worker checkpoints, ``distributed_evaluator.py:90-106``).
+
+Default task: LeNet on ``Digits`` — scikit-learn's bundled copy of the UCI
+handwritten-digit scans (real data, available with zero network egress) at
+MNIST geometry. With network access, ``--dataset MNIST`` runs the classic
+oracle instead (tools/data_prepare.py fetches the IDX files first).
+
+    python -m ps_pytorch_tpu.tools.accuracy_run --out ACCURACY.json
+"""
+
+import argparse
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+
+
+def run(argv=None) -> dict:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--dataset", default="Digits")
+    p.add_argument("--network", default="LeNet")
+    p.add_argument("--batch-size", type=int, default=128)
+    p.add_argument("--lr", type=float, default=0.01)
+    p.add_argument("--max-steps", type=int, default=1200)
+    p.add_argument("--target-prec1", type=float, default=0.98)
+    p.add_argument("--train-dir", default="./train_dir_accuracy")
+    p.add_argument("--out", default="")
+    p.add_argument("--timeout-s", type=float, default=1200.0)
+    args = p.parse_args(argv)
+
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    train_cmd = [
+        sys.executable, os.path.join(repo, "train.py"),
+        "--dataset", args.dataset, "--network", args.network,
+        "--batch-size", str(args.batch_size), "--lr", str(args.lr),
+        "--momentum", "0.9", "--weight-decay", "1e-4",
+        "--compute-dtype", "float32", "--epochs", "0",
+        "--max-steps", str(args.max_steps),
+        "--eval-freq", str(args.max_steps),     # one final checkpoint
+        "--log-every", "200", "--train-dir", args.train_dir,
+    ]
+    t0 = time.perf_counter()
+    tr = subprocess.run(train_cmd, capture_output=True, text=True,
+                        timeout=args.timeout_s, cwd=repo)
+    train_s = time.perf_counter() - t0
+    if tr.returncode != 0:
+        raise RuntimeError(f"train.py failed rc={tr.returncode}: "
+                           f"{(tr.stderr or tr.stdout)[-400:]}")
+
+    ev = subprocess.run(
+        [sys.executable, os.path.join(repo, "evaluate.py"),
+         "--train-dir", args.train_dir, "--once", str(args.max_steps)],
+        capture_output=True, text=True, timeout=args.timeout_s, cwd=repo)
+    m = re.search(r"EVAL step (\d+) loss ([\d.]+) prec1 ([\d.]+) prec5 ([\d.]+)",
+                  ev.stdout)
+    if ev.returncode != 0 or m is None:
+        raise RuntimeError(f"evaluate.py failed rc={ev.returncode}: "
+                           f"{(ev.stderr or ev.stdout)[-400:]}")
+    prec1, prec5 = float(m.group(3)), float(m.group(4))
+
+    # Platform probed in a TIMED child (importing jax here could hang the
+    # harness if the TPU tunnel is down — the compute already happened in
+    # the train/evaluate subprocesses either way).
+    try:
+        pr = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; d=jax.devices()[0]; print(d.platform, d.device_kind)"],
+            capture_output=True, text=True, timeout=90)
+        platform, kind = (pr.stdout.strip().split(" ", 1) + ["?"])[:2] \
+            if pr.returncode == 0 and pr.stdout.strip() else ("unknown", "?")
+    except subprocess.TimeoutExpired:
+        platform, kind = "unknown", "?"
+    result = {
+        "metric": "time_to_accuracy",
+        "dataset": args.dataset, "network": args.network,
+        "data": "real",
+        "steps": int(m.group(1)), "train_wall_s": round(train_s, 1),
+        "eval_loss": float(m.group(2)),
+        "prec1": prec1, "prec5": prec5,
+        "target_prec1": args.target_prec1,
+        "met_target": prec1 >= args.target_prec1,
+        "platform": platform,
+        "device_kind": kind,
+        "contract": "train.py checkpoint -> evaluate.py --once",
+    }
+    print(json.dumps(result))
+    if args.out:
+        with open(os.path.join(repo, args.out) if not os.path.isabs(args.out)
+                  else args.out, "w") as f:
+            json.dump(result, f, indent=1)
+    return result
+
+
+if __name__ == "__main__":
+    r = run()
+    sys.exit(0 if r["met_target"] else 1)

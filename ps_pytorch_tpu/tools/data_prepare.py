@@ -13,9 +13,83 @@ hammers the dataset mirrors — docstring contract at
 """
 
 import argparse
+import os
 import sys
+import tarfile
+import urllib.request
 
 from ps_pytorch_tpu.data.datasets import DATASET_SHAPES, load_arrays
+
+# Standard mirrors for the raw files data/vision_io parses. Each entry:
+# dataset -> (target subdir, [(relative path or archive, [urls])...]).
+# Tarballs are extracted into the data dir (their internal layout already
+# matches what vision_io expects).
+_MIRRORS = {
+    "MNIST": ("MNIST/raw", [
+        (f"{split}-{kind}", [
+            f"https://storage.googleapis.com/cvdf-datasets/mnist/{split}-{kind}",
+            f"https://ossci-datasets.s3.amazonaws.com/mnist/{split}-{kind}",
+        ])
+        for split in ("train", "t10k")
+        for kind in ("images-idx3-ubyte.gz", "labels-idx1-ubyte.gz")
+    ]),
+    "Cifar10": ("", [("cifar-10-python.tar.gz", [
+        "https://www.cs.toronto.edu/~kriz/cifar-10-python.tar.gz"])]),
+    "Cifar100": ("", [("cifar-100-python.tar.gz", [
+        "https://www.cs.toronto.edu/~kriz/cifar-100-python.tar.gz"])]),
+    "SVHN": ("", [(f"{split}_32x32.mat", [
+        f"http://ufldl.stanford.edu/housenumbers/{split}_32x32.mat"])
+        for split in ("train", "test")]),
+}
+
+
+def _fetch(urls, dest: str, timeout: float = 30.0) -> None:
+    # Explicit socket timeout: egress-filtered environments often black-hole
+    # rather than refuse, and a stalled first mirror must fail over to the
+    # next one instead of hanging the prepare step forever.
+    last = None
+    for url in urls:
+        try:
+            tmp = dest + ".part"
+            with urllib.request.urlopen(url, timeout=timeout) as r, \
+                    open(tmp, "wb") as f:
+                while True:
+                    chunk = r.read(1 << 20)
+                    if not chunk:
+                        break
+                    f.write(chunk)
+            os.replace(tmp, dest)
+            return
+        except Exception as e:
+            last = e
+    raise RuntimeError(f"all mirrors failed for {os.path.basename(dest)}: {last}")
+
+
+def ensure_downloaded(name: str, root: str) -> None:
+    """Fetch ``name``'s raw files into ``root`` if absent (idempotent)."""
+    if name not in _MIRRORS:
+        return   # Digits is bundled with sklearn; synthetic needs nothing
+    subdir, files = _MIRRORS[name]
+    base = os.path.join(root, subdir) if subdir else root
+    os.makedirs(base, exist_ok=True)
+    for rel, urls in files:
+        dest = os.path.join(base, rel)
+        if rel.endswith(".tar.gz"):
+            # Idempotency keys on the EXTRACTED marker dir, not the
+            # tarball: a fetch interrupted mid-extract (or a manually
+            # dropped-in tarball) must still extract on the next run.
+            marker = {"cifar-10-python.tar.gz": "cifar-10-batches-py",
+                      "cifar-100-python.tar.gz": "cifar-100-python"}[rel]
+            if os.path.exists(os.path.join(root, marker)):
+                continue
+            if not os.path.exists(dest):
+                _fetch(urls, dest)
+            with tarfile.open(dest) as tf:
+                tf.extractall(root, filter="data")
+            continue
+        plain = dest[:-3] if rel.endswith(".gz") else dest
+        if not (os.path.exists(dest) or os.path.exists(plain)):
+            _fetch(urls, dest)
 
 
 def main(argv=None) -> int:

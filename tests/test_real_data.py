@@ -1,0 +1,60 @@
+"""Real-dataset loading + accuracy (VERDICT r2 item 3).
+
+``Digits`` is real data (sklearn's bundled UCI handwritten-digit scans), so
+the accuracy oracle runs even with zero network egress; the MNIST/CIFAR
+file parsers are exercised against files only when present (skip-if-no-data
+— the pre-download contract means CI hosts may not have them).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from ps_pytorch_tpu.config import TrainConfig
+from ps_pytorch_tpu.data.datasets import load_arrays
+
+
+def test_digits_loads_real_scans():
+    xtr, ytr = load_arrays("Digits", train=True)
+    xte, yte = load_arrays("Digits", train=False)
+    assert xtr.shape == (1437, 28, 28, 1) and xtr.dtype == np.uint8
+    assert xte.shape == (360, 28, 28, 1)
+    # Disjoint split, all 10 classes present in both.
+    assert set(ytr.tolist()) == set(range(10)) == set(yte.tolist())
+    # Real scans: nontrivial per-class pixel structure (not noise): class
+    # means must differ pairwise.
+    means = np.stack([xtr[ytr == c].mean(axis=0) for c in range(10)])
+    d = np.abs(means[:, None] - means[None, :]).mean(axis=(2, 3, 4))
+    assert (d[np.triu_indices(10, 1)] > 1.0).all()
+
+
+def test_digits_lenet_reaches_90pct_quick():
+    """Short real-data training through the full Trainer stack: >=90% Prec@1
+    in 250 steps (the committed artifact runs the 1200-step version to the
+    >=98% reference bar via tools/accuracy_run.py)."""
+    from ps_pytorch_tpu.runtime.trainer import Trainer
+
+    cfg = TrainConfig(dataset="Digits", network="LeNet", batch_size=128,
+                      lr=0.01, momentum=0.9, weight_decay=1e-4,
+                      compute_dtype="float32", max_steps=250, epochs=0,
+                      eval_freq=0, log_every=1000)
+    t = Trainer(cfg)
+    t.train()
+    r = t.evaluate()
+    assert r["prec1"] >= 0.90, r
+
+
+@pytest.mark.skipif(not os.path.exists("./data/MNIST/raw"),
+                    reason="MNIST files not present (pre-download contract)")
+def test_mnist_idx_parser():
+    x, y = load_arrays("MNIST", "./data", train=False)
+    assert x.shape == (10000, 28, 28, 1) and x.dtype == np.uint8
+    assert y.min() >= 0 and y.max() == 9
+
+
+@pytest.mark.skipif(not os.path.exists("./data/cifar-10-batches-py"),
+                    reason="CIFAR-10 files not present (pre-download contract)")
+def test_cifar10_pickle_parser():
+    x, y = load_arrays("Cifar10", "./data", train=False)
+    assert x.shape == (10000, 32, 32, 3) and x.dtype == np.uint8
