@@ -148,15 +148,16 @@ def child_main(args) -> int:
         try:
             big = 4 * args.per_device_batch * n_dev
             st_b, fn_b, x_b, y_b, m_b = _build("ResNet18", "Cifar10", big)
-            b4096_sps = time_steps(st_b, fn_b, x_b, y_b, m_b,
-                                   steps=max(args.steps // 2, 5),
-                                   warmup=args.warmup)
-            out["b4096_images_per_sec"] = round(big / b4096_sps, 1)
+            big_sps = time_steps(st_b, fn_b, x_b, y_b, m_b,
+                                 steps=max(args.steps // 2, 5),
+                                 warmup=args.warmup)
+            out["bigbatch_global_batch"] = big
+            out["bigbatch_images_per_sec"] = round(big / big_sps, 1)
             if peak:
-                out["b4096_mfu"] = round(
-                    flops_per_image * big / b4096_sps / (peak * n_dev), 4)
+                out["bigbatch_mfu"] = round(
+                    flops_per_image * big / big_sps / (peak * n_dev), 4)
         except Exception as e:
-            out["b4096_error"] = f"{type(e).__name__}: {e}"[:200]
+            out["bigbatch_error"] = f"{type(e).__name__}: {e}"[:200]
 
     print(json.dumps(out))
     return 0

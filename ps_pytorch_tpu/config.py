@@ -62,6 +62,7 @@ class TrainConfig:
     staleness_decay: float = 0.0     # async mode: weight = decay**staleness; 0 = no decay (pure average)
     async_slices: int = 2            # async mode: device groups acting as independent slices
     fetch_every: int = 1             # async mode: slice re-fetches canonical weights every N of its steps
+    publish_every: int = 1           # async leader publishes canonical params every N applied updates (bounds DCN publish traffic; final state always published)
     data_axis: int = 0               # number of data-parallel shards; 0 = all local devices
     model_axis: int = 1              # reserved mesh axis for TP (unused by these models)
     sync_batchnorm: bool = False     # reference keeps BN stats worker-local (distributed_worker.py:245-252)

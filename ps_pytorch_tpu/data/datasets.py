@@ -13,9 +13,10 @@ differences, TPU-first:
 - A ``synthetic`` dataset (shape-compatible with CIFAR/MNIST) backs tests and
   throughput benches with zero I/O.
 
-Real datasets load through torchvision when the files are already on disk
-(``data_prepare.py`` pre-download contract); downloads are attempted only when
-``download=True``.
+Real datasets load through the self-contained parsers in ``vision_io.py``
+(MNIST IDX, CIFAR pickle batches, SVHN .mat, sklearn-bundled Digits) when
+the files are already on disk (``data_prepare.py`` pre-download contract);
+downloads are attempted only when ``download=True``.
 """
 
 import queue

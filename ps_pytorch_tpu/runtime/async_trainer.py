@@ -247,7 +247,12 @@ class AsyncTrainer:
             self.applied += 1
             used = len(pool["used"])
             self.aggregator.consume(pool["used"])
-            self._publish_canonical()
+            # publish_every > 1 trades follower freshness for DCN publish
+            # traffic (the full param tree crosses the wire per publish —
+            # wire_stats records what that costs). The final state is
+            # always published in train() before set_done.
+            if self.applied % max(self.cfg.publish_every, 1) == 0:
+                self._publish_canonical()
             if self.cfg.eval_freq > 0 and self.version % self.cfg.eval_freq == 0:
                 self._checkpoint()
         self.dropped_stale += self.aggregator.drop_older_than(self.version)
@@ -316,6 +321,9 @@ class AsyncTrainer:
         if self.leader:
             if cfg.eval_freq > 0 and self.version % cfg.eval_freq != 0:
                 self._checkpoint()
+            # Canonical final state visible to every process regardless of
+            # publish_every (evaluate() and late followers read it).
+            self._publish_canonical()
             self.transport.set_done(self.version)
         self.metrics.close()
         return self.params

@@ -84,8 +84,18 @@ def ensure_downloaded(name: str, root: str) -> None:
                 continue
             if not os.path.exists(dest):
                 _fetch(urls, dest)
+            # Extract to a temp dir, then atomically move the marker dir
+            # into place — an interrupted extract must leave NO marker, so
+            # the next run repairs it instead of trusting half a dataset.
+            tmp = os.path.join(root, f".extract_tmp_{marker}")
+            if os.path.exists(tmp):
+                import shutil
+                shutil.rmtree(tmp)
             with tarfile.open(dest) as tf:
-                tf.extractall(root, filter="data")
+                tf.extractall(tmp, filter="data")
+            os.replace(os.path.join(tmp, marker),
+                       os.path.join(root, marker))
+            os.rmdir(tmp)
             continue
         plain = dest[:-3] if rel.endswith(".gz") else dest
         if not (os.path.exists(dest) or os.path.exists(plain)):

@@ -1,0 +1,208 @@
+#!/usr/bin/env python
+"""Cluster provisioning — TPU pod slices as the reference provisioned EC2.
+
+The reference's ``tools/pytorch_ec2.py`` owned the full instance lifecycle:
+spot-request launch (``:176-209``), wait-until-initialized (``:209-233``),
+instance summaries (``:100-128``), teardown (``:155-176``), hostfile
+generation for mpirun (``get_hosts``, ``:656-820``), code push + NFS
+(``:880-905``), remote command fan-out (``:854-880``), and the one-shot
+``clean_launch_and_run`` (``:916-928``). This module is the TPU-native
+re-expression over the ``gcloud compute tpus tpu-vm`` surface:
+
+    provision create  --name ps1 --zone us-central2-b --type v4-32
+    provision wait    --name ps1 ...          # poll until state=READY
+    provision status  [--name ps1] ...        # list / summarize
+    provision hostfile --name ps1 --out hosts_address
+    provision push    --name ps1 --src .      # code to every worker VM
+    provision run     --name ps1 --command "cmd"   # fan out a shell command
+    provision delete  --name ps1
+    provision up      --name ps1 ...          # create+wait+hostfile+push
+
+``hostfile`` writes the launcher's format (one worker IP per line,
+``tools/launch.py --hostfile``), so provisioning composes with the existing
+fleet control exactly as ec2 composed with mpirun's hosts_address.
+
+Every subcommand takes ``--dry-run`` (print the exact gcloud invocations,
+run nothing) and the executor is injectable, so the full command surface is
+unit-tested without a cloud project (tests/test_provision.py) — the same
+test posture as launch.py's ``--simulate``.
+"""
+
+import argparse
+import json
+import shlex
+import subprocess
+import sys
+import time
+from typing import Callable, List, Optional
+
+Runner = Callable[[List[str]], "subprocess.CompletedProcess"]
+
+
+def _run(cmd: List[str]) -> "subprocess.CompletedProcess":
+    return subprocess.run(cmd, capture_output=True, text=True)
+
+
+class TpuPodProvisioner:
+    """Lifecycle driver for one named TPU pod slice."""
+
+    def __init__(self, name: str, zone: str, project: str = "",
+                 runner: Optional[Runner] = None, dry_run: bool = False,
+                 printer: Callable = print):
+        self.name = name
+        self.zone = zone
+        self.project = project
+        self.dry_run = dry_run
+        self.printer = printer
+        self._runner = runner or _run
+
+    # ---- gcloud plumbing ----
+    def _base(self) -> List[str]:
+        cmd = ["gcloud", "compute", "tpus", "tpu-vm"]
+        return cmd
+
+    def _common(self) -> List[str]:
+        out = ["--zone", self.zone]
+        if self.project:
+            out += ["--project", self.project]
+        return out
+
+    def _exec(self, cmd: List[str]) -> "subprocess.CompletedProcess":
+        if self.dry_run:
+            self.printer("DRYRUN " + " ".join(shlex.quote(c) for c in cmd))
+            return subprocess.CompletedProcess(cmd, 0, "", "")
+        r = self._runner(cmd)
+        if r.returncode != 0:
+            raise RuntimeError(
+                f"{' '.join(cmd[:6])}... rc={r.returncode}: {r.stderr[-300:]}")
+        return r
+
+    # ---- lifecycle (ec2: launch_instances / terminate_all_instances) ----
+    def create(self, accelerator_type: str, version: str,
+               spot: bool = False) -> None:
+        cmd = self._base() + ["create", self.name] + self._common() + [
+            "--accelerator-type", accelerator_type,
+            "--version", version]
+        if spot:
+            # The reference ran spot requests for cost (pytorch_ec2.py:176
+            # launches spot instances); preemptible TPU is the analogue.
+            cmd.append("--spot")
+        self._exec(cmd)
+
+    def delete(self) -> None:
+        self._exec(self._base() + ["delete", self.name, "--quiet"]
+                   + self._common())
+
+    def describe(self) -> dict:
+        r = self._exec(self._base() + ["describe", self.name]
+                       + self._common() + ["--format", "json"])
+        return json.loads(r.stdout) if r.stdout.strip() else {}
+
+    def list(self) -> List[dict]:
+        r = self._exec(self._base() + ["list"] + self._common()
+                       + ["--format", "json"])
+        return json.loads(r.stdout) if r.stdout.strip() else []
+
+    def wait_ready(self, timeout_s: float = 900.0, poll_s: float = 10.0,
+                   sleep=time.sleep) -> dict:
+        """Poll describe until state=READY (ec2's
+        wait_until_running_instances_initialized, :209-233)."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            d = self.describe()
+            state = d.get("state", "DRYRUN" if self.dry_run else "UNKNOWN")
+            self.printer(f"STATE {self.name} {state}")
+            if state in ("READY", "DRYRUN"):
+                return d
+            if state in ("PREEMPTED", "TERMINATED", "FAILED"):
+                raise RuntimeError(f"{self.name} entered state {state}")
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"{self.name} not READY in {timeout_s}s")
+            sleep(poll_s)
+
+    # ---- composition points ----
+    def worker_ips(self, internal: bool = True) -> List[str]:
+        """Worker VM IPs in worker order — the launcher's hostfile rows
+        (ec2 get_hosts wrote hosts_address the same way, :656-820)."""
+        d = self.describe()
+        ips = []
+        for ep in d.get("networkEndpoints", []):
+            if internal:
+                ips.append(ep.get("ipAddress", ""))
+            else:
+                ips.append(ep.get("accessConfig", {}).get("externalIp", ""))
+        return [ip for ip in ips if ip]
+
+    def write_hostfile(self, path: str, internal: bool = True) -> List[str]:
+        ips = self.worker_ips(internal=internal)
+        if not ips and not self.dry_run:
+            raise RuntimeError(f"{self.name} reports no network endpoints")
+        with open(path, "w") as f:
+            f.write("# generated by provision hostfile: one worker VM per line\n")
+            for ip in ips:
+                f.write(ip + "\n")
+        self.printer(f"HOSTFILE {path} workers={len(ips)}")
+        return ips
+
+    def push(self, src: str, dest: str = "~/ps_pytorch_tpu") -> None:
+        """Code distribution (ec2 pushed via NFS + git dir sync, :880-905)."""
+        self._exec(self._base() + ["scp", "--recurse", src,
+                                   f"{self.name}:{dest}", "--worker", "all"]
+                   + self._common())
+
+    def run(self, command: str) -> None:
+        """Fan a shell command to every worker (ec2 run_command, :854-880)."""
+        self._exec(self._base() + ["ssh", self.name, "--worker", "all",
+                                   "--command", command] + self._common())
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("cmd", choices=["create", "delete", "status", "wait",
+                                   "hostfile", "push", "run", "up"])
+    p.add_argument("--name", default="ps-tpu-1")
+    p.add_argument("--zone", default="us-central2-b")
+    p.add_argument("--project", default="")
+    p.add_argument("--type", dest="accel", default="v5litepod-8")
+    p.add_argument("--version", default="tpu-ubuntu2204-base")
+    p.add_argument("--spot", action="store_true")
+    p.add_argument("--out", default="hosts_address")
+    p.add_argument("--external-ips", action="store_true",
+                   help="hostfile uses external IPs (default: internal)")
+    p.add_argument("--src", default=".")
+    p.add_argument("--command", default="")
+    p.add_argument("--timeout-s", type=float, default=900.0)
+    p.add_argument("--dry-run", action="store_true")
+    args = p.parse_args(argv)
+
+    pr = TpuPodProvisioner(args.name, args.zone, args.project,
+                           dry_run=args.dry_run)
+    if args.cmd == "create":
+        pr.create(args.accel, args.version, spot=args.spot)
+    elif args.cmd == "delete":
+        pr.delete()
+    elif args.cmd == "wait":
+        pr.wait_ready(timeout_s=args.timeout_s)
+    elif args.cmd == "status":
+        for d in pr.list():
+            print(f"{d.get('name','?')}\t{d.get('state','?')}\t"
+                  f"{d.get('acceleratorType','?')}")
+    elif args.cmd == "hostfile":
+        pr.write_hostfile(args.out, internal=not args.external_ips)
+    elif args.cmd == "push":
+        pr.push(args.src)
+    elif args.cmd == "run":
+        if not args.command:
+            raise SystemExit("run requires --command")
+        pr.run(args.command)
+    elif args.cmd == "up":
+        # ec2 clean_launch_and_run (:916-928): one shot to a usable fleet.
+        pr.create(args.accel, args.version, spot=args.spot)
+        pr.wait_ready(timeout_s=args.timeout_s)
+        pr.write_hostfile(args.out, internal=not args.external_ips)
+        pr.push(args.src)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

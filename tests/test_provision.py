@@ -1,0 +1,99 @@
+"""Provisioning layer (tools/provision.py) — the full command surface
+exercised against an injected fake gcloud runner / dry-run printer, the
+test posture launch.py uses for fleets (no cloud project in CI; the
+reference's ec2 tooling had no tests at all)."""
+
+import json
+import subprocess
+
+import pytest
+
+from ps_pytorch_tpu.tools.provision import TpuPodProvisioner, main
+
+
+class FakeGcloud:
+    def __init__(self, describe=None, fail=False):
+        self.calls = []
+        self.describe = describe or {}
+        self.fail = fail
+
+    def __call__(self, cmd):
+        self.calls.append(cmd)
+        if self.fail:
+            return subprocess.CompletedProcess(cmd, 1, "", "boom")
+        out = ""
+        if "describe" in cmd:
+            out = json.dumps(self.describe)
+        elif "list" in cmd:
+            out = json.dumps([{"name": "ps1", "state": "READY",
+                               "acceleratorType": "v4-32"}])
+        return subprocess.CompletedProcess(cmd, 0, out, "")
+
+
+def test_create_wait_hostfile_push_composition(tmp_path):
+    desc = {"state": "READY", "networkEndpoints": [
+        {"ipAddress": "10.0.0.2",
+         "accessConfig": {"externalIp": "34.1.2.3"}},
+        {"ipAddress": "10.0.0.3",
+         "accessConfig": {"externalIp": "34.1.2.4"}},
+    ]}
+    fake = FakeGcloud(describe=desc)
+    pr = TpuPodProvisioner("ps1", "us-central2-b", "proj", runner=fake,
+                           printer=lambda *a: None)
+    pr.create("v4-32", "tpu-ubuntu2204-base", spot=True)
+    assert fake.calls[0][:6] == ["gcloud", "compute", "tpus", "tpu-vm",
+                                 "create", "ps1"]
+    assert "--spot" in fake.calls[0] and "--project" in fake.calls[0]
+
+    d = pr.wait_ready(timeout_s=1.0, sleep=lambda s: None)
+    assert d["state"] == "READY"
+
+    hf = tmp_path / "hosts_address"
+    ips = pr.write_hostfile(str(hf))
+    assert ips == ["10.0.0.2", "10.0.0.3"]
+    # The launcher's hostfile parser must accept the generated file.
+    from ps_pytorch_tpu.tools.launch import _read_hostfile
+    assert _read_hostfile(str(hf)) == ips
+    assert pr.worker_ips(internal=False) == ["34.1.2.3", "34.1.2.4"]
+
+    pr.push(".")
+    assert any("scp" in c for c in fake.calls[-1])
+    pr.run("pkill -f train.py")
+    assert "--command" in fake.calls[-1]
+
+
+def test_wait_surfaces_terminal_states():
+    fake = FakeGcloud(describe={"state": "PREEMPTED"})
+    pr = TpuPodProvisioner("ps1", "z", runner=fake, printer=lambda *a: None)
+    with pytest.raises(RuntimeError, match="PREEMPTED"):
+        pr.wait_ready(timeout_s=1.0, sleep=lambda s: None)
+
+
+def test_gcloud_failure_raises_with_stderr():
+    pr = TpuPodProvisioner("ps1", "z", runner=FakeGcloud(fail=True),
+                           printer=lambda *a: None)
+    with pytest.raises(RuntimeError, match="boom"):
+        pr.delete()
+
+
+def test_dry_run_prints_commands_and_runs_nothing(capsys):
+    ran = []
+    pr = TpuPodProvisioner("ps1", "z", runner=lambda c: ran.append(c),
+                           dry_run=True)
+    pr.create("v5litepod-8", "tpu-ubuntu2204-base")
+    pr.delete()
+    out = capsys.readouterr().out
+    assert ran == []
+    assert "DRYRUN gcloud compute tpus tpu-vm create ps1" in out
+    assert "DRYRUN gcloud compute tpus tpu-vm delete ps1" in out
+
+
+def test_cli_dry_run_up(tmp_path, capsys):
+    hf = tmp_path / "hosts"
+    rc = main(["up", "--name", "ps9", "--zone", "eu-west4-a",
+               "--type", "v4-16", "--dry-run", "--out", str(hf)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "create ps9" in out and "scp" in out
+    # Dry-run hostfile exists (empty worker list) but is well-formed.
+    assert hf.read_text().startswith("#")

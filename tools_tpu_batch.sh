@@ -1,0 +1,16 @@
+#!/bin/bash
+# Round-3 TPU evidence batch: runs once the axon tunnel is answering.
+# Regenerates the suite artifact (loader/convergence/async/quantizer rows
+# changed since the first TPU run), captures the profiler trace, redoes the
+# accuracy artifact on the chip, and exercises bench.py's extras path.
+cd /root/repo || exit 1
+timeout 90 python -c "import jax; d=jax.devices()[0]; assert d.platform=='tpu', d" || exit 7
+set -x
+python bench_suite.py --steps 20 --markdown BENCH_SUITE_r03.md \
+    > BENCH_SUITE_r03.json 2>/tmp/suite_err.log
+python -m ps_pytorch_tpu.tools.profile_capture --out ./profile_r03 \
+    > /tmp/profile_digest.json 2>/tmp/profile_err.log
+python -m ps_pytorch_tpu.tools.accuracy_run --out ACCURACY_r03.json \
+    > /tmp/acc_tpu.log 2>&1
+python bench.py > /tmp/bench_headline.json 2>/tmp/bench_err.log
+echo TPU_BATCH_DONE
