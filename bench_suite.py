@@ -225,6 +225,55 @@ def bench_async_multislice(name, steps, *, network="ResNet18",
             "pool_wire_bytes": t.aggregator.wire_bytes()}
 
 
+def bench_transformer_lm(name, steps, *, batch=8, seq_len=2048, d_model=512,
+                         n_layers=8, n_heads=8, vocab=32000):
+    """Transformer-LM training throughput (tokens/sec) — the long-context
+    surface (SURVEY: SP/ring attention first-class) benched next to the CNN
+    rows. Single-axis mesh over all devices; ring attention shards the
+    sequence when >1 device is present, full attention on one device (ring
+    degenerates to a pointless self-permute there)."""
+    import jax
+    from ps_pytorch_tpu.models.transformer import TransformerLM
+    from ps_pytorch_tpu.optim import build_optimizer
+    from ps_pytorch_tpu.config import TrainConfig
+    from ps_pytorch_tpu.parallel.mesh import make_mesh
+    from ps_pytorch_tpu.parallel.sp import (
+        create_lm_train_state, make_sp_train_step,
+    )
+
+    devices = jax.devices()
+    n = len(devices)
+    mesh = make_mesh(data=n, devices=devices)
+    impl = "ring" if n > 1 else "full"
+    model = TransformerLM(vocab_size=vocab, d_model=d_model,
+                          n_layers=n_layers, n_heads=n_heads,
+                          max_seq_len=seq_len, attention_impl=impl,
+                          axis_name="data")
+    cfg = TrainConfig(dataset="synthetic", network="LeNet", batch_size=batch,
+                      lr=0.01, momentum=0.9)
+    tx = build_optimizer(cfg)
+    state = create_lm_train_state(model, tx, mesh, (batch, seq_len))
+    step_fn = make_sp_train_step(model, tx, mesh)
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, vocab, size=(batch, seq_len)),
+                         jnp.int32)
+    for _ in range(3):
+        state, m = step_fn(state, tokens)
+    jax.block_until_ready(state.params)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, m = step_fn(state, tokens)
+    jax.block_until_ready(state.params)
+    dt = (time.perf_counter() - t0) / steps
+    toks = batch * seq_len
+    return {"config": name, "attention": impl, "devices": n,
+            "batch": batch, "seq_len": seq_len, "d_model": d_model,
+            "n_layers": n_layers,
+            "sec_per_step": round(dt, 5),
+            "tokens_per_sec": round(toks / dt, 1),
+            "loss": round(float(m["loss"]), 4)}
+
+
 def bench_time_to_loss(name, network, dataset, batch, target_loss,
                        max_steps=200):
     """Convergence probe: wall-clock to reach target training loss on a
@@ -286,6 +335,8 @@ CONFIGS = {
     "int8_quantizer": lambda steps: bench_quantizer("int8_quantizer", steps),
     "resnet18_async_2slice": lambda steps: bench_async_multislice(
         "resnet18_async_2slice", steps),
+    "transformer_lm_2k": lambda steps: bench_transformer_lm(
+        "transformer_lm_2k", steps),
     "lenet_convergence": lambda steps: bench_time_to_loss(
         "lenet_convergence", "LeNet", "synthetic_mnist", 512,
         target_loss=0.8),

@@ -1,0 +1,52 @@
+// Native data-loader core: fused shuffle-gather + random-crop + hflip.
+//
+// The TPU-native answer to the reference's vendored multiprocess DataLoader
+// (my_data_loader.py:37-75 worker processes): the augmentation hot path as a
+// single threaded C++ pass instead of Python worker processes. The Python
+// loop (data/augment.py crop_flip_prepadded) pays per-image interpreter
+// overhead and holds the GIL; this kernel copies each output row with
+// memcpy (or a reversed per-pixel copy when flipped) across an OpenMP team,
+// and the ctypes call releases the GIL for the whole batch.
+//
+// Layout contract (matches the pre-padded store in data/datasets.py):
+//   padded: [N, PH, PW, C] uint8, C-contiguous
+//   out:    [B, H,  W,  C] uint8, C-contiguous
+//   sel/ys/xs/flip: int64/int32/int32/uint8 [B]
+// ys/xs are the crop offsets into the padded image; flip reverses W.
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+void psl_crop_flip_batch(const uint8_t *padded, const int64_t *sel,
+                         const int32_t *ys, const int32_t *xs,
+                         const uint8_t *flip, uint8_t *out,
+                         int64_t b, int64_t h, int64_t w, int64_t c,
+                         int64_t ph, int64_t pw) {
+    const int64_t img_in = ph * pw * c;
+    const int64_t row_in = pw * c;
+    const int64_t img_out = h * w * c;
+    const int64_t row_out = w * c;
+#pragma omp parallel for schedule(static)
+    for (int64_t i = 0; i < b; ++i) {
+        const uint8_t *src_img =
+            padded + sel[i] * img_in + ys[i] * row_in + xs[i] * c;
+        uint8_t *dst_img = out + i * img_out;
+        if (!flip[i]) {
+            for (int64_t r = 0; r < h; ++r)
+                std::memcpy(dst_img + r * row_out, src_img + r * row_in,
+                            row_out);
+        } else {
+            for (int64_t r = 0; r < h; ++r) {
+                const uint8_t *src_row = src_img + r * row_in;
+                uint8_t *dst_row = dst_img + r * row_out;
+                for (int64_t x = 0; x < w; ++x)
+                    std::memcpy(dst_row + x * c,
+                                src_row + (w - 1 - x) * c, c);
+            }
+        }
+    }
+}
+
+}  // extern "C"

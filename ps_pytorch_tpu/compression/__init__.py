@@ -16,9 +16,7 @@ reference's every-step Blosc path — there is nothing to compress there.
 """
 
 import ctypes
-import os
 import struct
-import subprocess
 import zlib
 from typing import Optional
 
@@ -28,32 +26,26 @@ _MAGIC = b"PSC1"
 _CODEC_ZSTD = 1
 _CODEC_ZLIB = 2
 
-_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
 _lib: Optional[ctypes.CDLL] = None
 _lib_tried = False
 
 
+def _configure_codec(lib: ctypes.CDLL) -> None:
+    lib.psc_compress.restype = ctypes.c_longlong
+    lib.psc_decompress.restype = ctypes.c_longlong
+    lib.psc_max_compressed_size.restype = ctypes.c_size_t
+    lib.psc_max_compressed_size.argtypes = [ctypes.c_size_t]
+
+
 def _load_native() -> Optional[ctypes.CDLL]:
     global _lib, _lib_tried
-    if _lib_tried:
-        return _lib
-    _lib_tried = True
-    so = os.path.abspath(os.path.join(_NATIVE_DIR, "libpscodec.so"))
-    if not os.path.exists(so):
-        try:
-            subprocess.run(["make", "-C", os.path.abspath(_NATIVE_DIR)],
-                           capture_output=True, timeout=120, check=True)
-        except Exception:
-            return None
-    try:
-        lib = ctypes.CDLL(so)
-        lib.psc_compress.restype = ctypes.c_longlong
-        lib.psc_decompress.restype = ctypes.c_longlong
-        lib.psc_max_compressed_size.restype = ctypes.c_size_t
-        lib.psc_max_compressed_size.argtypes = [ctypes.c_size_t]
-        _lib = lib
-    except OSError:
-        _lib = None
+    if not _lib_tried:
+        # Shared per-target build protocol (utils/native.py): building ONLY
+        # libpscodec.so means a toolchain lacking OpenMP (the loader's dep)
+        # can't break the codec build, and vice versa for libzstd.
+        from ps_pytorch_tpu.utils.native import load_native_lib
+        _lib = load_native_lib("libpscodec.so", _configure_codec)
+        _lib_tried = True
     return _lib
 
 

@@ -106,6 +106,14 @@ class TrainConfig:
             raise ValueError(f"unknown grad_codec {self.grad_codec!r} (blosc | int8)")
         if self.nesterov and (self.momentum <= 0):
             raise ValueError("Nesterov momentum requires a momentum")
+        if self.mode == "async" and self.publish_every > max(self.staleness_limit, 1):
+            # Followers only ever see published versions: a publish gap
+            # wider than the staleness window makes EVERY follower gradient
+            # permanently stale (silently leader-only training).
+            raise ValueError(
+                f"publish_every={self.publish_every} > "
+                f"staleness_limit={self.staleness_limit}: followers could "
+                f"never contribute a fresh-enough gradient")
 
     # ---- serialization (into checkpoints / across the control plane) ----
     def to_json(self) -> str:

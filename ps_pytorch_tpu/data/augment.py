@@ -13,9 +13,31 @@ per-step augmentation cost is hidden behind device compute by the prefetching
 loader in datasets.py).
 """
 
+import ctypes
 from typing import Optional
 
 import numpy as np
+
+_loader_lib = None
+_loader_tried = False
+
+
+def _configure_loader(lib: "ctypes.CDLL") -> None:
+    lib.psl_crop_flip_batch.argtypes = [ctypes.c_void_p] * 6 + \
+        [ctypes.c_int64] * 6
+    lib.psl_crop_flip_batch.restype = None
+
+
+def _load_native_loader():
+    """ctypes handle to the C++ crop+flip kernel (native/loader.cpp), built
+    on demand via the shared protocol (utils/native.py); None -> numpy
+    fallback."""
+    global _loader_lib, _loader_tried
+    if not _loader_tried:
+        from ps_pytorch_tpu.utils.native import load_native_lib
+        _loader_lib = load_native_lib("libpsloader.so", _configure_loader)
+        _loader_tried = True
+    return _loader_lib
 
 MNIST_MEAN, MNIST_STD = (0.1307,), (0.3081,)
 CIFAR_MEAN = np.array([125.3, 123.0, 113.9], np.float32) / 255.0
@@ -109,6 +131,23 @@ def crop_flip_prepadded(padded: np.ndarray, sel: np.ndarray,
     flip = rng.random(b) < 0.5
     if out is None:
         out = np.empty((b, h, w, c), padded.dtype)
+    # Native path (uint8 contiguous only — the storage contract of the
+    # pre-padded store): one GIL-free OpenMP pass over the batch, memcpy per
+    # row. Same ys/xs/flip draws either way, so native and numpy paths are
+    # bit-identical (tested: test_data.py::test_native_loader_bit_identical).
+    lib = _load_native_loader()
+    if (lib is not None and padded.dtype == np.uint8
+            and out.shape == (b, h, w, c) and out.dtype == padded.dtype
+            and padded.flags.c_contiguous and out.flags.c_contiguous):
+        sel64 = np.ascontiguousarray(sel, np.int64)
+        ys32 = np.ascontiguousarray(ys, np.int32)
+        xs32 = np.ascontiguousarray(xs, np.int32)
+        fl8 = np.ascontiguousarray(flip, np.uint8)
+        lib.psl_crop_flip_batch(
+            padded.ctypes.data, sel64.ctypes.data, ys32.ctypes.data,
+            xs32.ctypes.data, fl8.ctypes.data, out.ctypes.data,
+            b, h, w, c, padded.shape[1], padded.shape[2])
+        return out
     for i in range(b):
         v = padded[sel[i], ys[i]:ys[i] + h, xs[i]:xs[i] + w]
         out[i] = v[:, ::-1] if flip[i] else v

@@ -265,7 +265,8 @@ class MultiSliceTrainer:
                     self.step, 0, loss=info["loss"], acc=info["acc"],
                     participating=float(len(info["used"])),
                     step_time=time.monotonic() - t0, data_time=0.0,
-                    applied=self.applied, dropped_stale=self.dropped_stale)
+                    applied=self.applied, dropped_stale=self.dropped_stale,
+                    pool_wire_bytes=self.aggregator.wire_bytes())
             if cfg.eval_freq > 0 and self.step % cfg.eval_freq == 0:
                 self._checkpoint()
         if cfg.eval_freq > 0 and self.step % cfg.eval_freq != 0:

@@ -109,7 +109,18 @@ class TpuPodProvisioner:
         wait_until_running_instances_initialized, :209-233)."""
         deadline = time.monotonic() + timeout_s
         while True:
-            d = self.describe()
+            try:
+                d = self.describe()
+            except RuntimeError as e:
+                # Transient API errors during a minutes-long readiness wait
+                # must not abort `up` with a half-provisioned (and billing)
+                # pod — keep polling until the deadline (the ec2 pollers
+                # this replaces likewise polled through errors).
+                if time.monotonic() > deadline:
+                    raise
+                self.printer(f"DESCRIBE-RETRY {e}")
+                sleep(poll_s)
+                continue
             state = d.get("state", "DRYRUN" if self.dry_run else "UNKNOWN")
             self.printer(f"STATE {self.name} {state}")
             if state in ("READY", "DRYRUN"):

@@ -1,0 +1,38 @@
+"""Shared build-on-demand loader for the native C++ libraries (native/).
+
+One protocol for every .so: look for it, `make` its SPECIFIC target when
+absent (so one library's missing system dependency — e.g. libzstd for the
+codec — cannot disable another's build), dlopen, apply the caller's symbol
+configuration. Callers cache the result module-side; None means "use the
+Python fallback"."""
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Callable, Optional
+
+NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "native")
+
+_lock = threading.Lock()
+
+
+def load_native_lib(so_name: str,
+                    configure: Callable[[ctypes.CDLL], None],
+                    make_dir: str = "") -> Optional[ctypes.CDLL]:
+    make_dir = make_dir or NATIVE_DIR
+    so = os.path.join(make_dir, so_name)
+    with _lock:
+        if not os.path.exists(so):
+            try:
+                subprocess.run(["make", "-C", make_dir, so_name],
+                               capture_output=True, timeout=120, check=True)
+            except Exception:
+                return None
+        try:
+            lib = ctypes.CDLL(so)
+            configure(lib)
+            return lib
+        except OSError:
+            return None

@@ -149,6 +149,29 @@ def test_next_batch_advances_epochs():
         assert xb.shape[0] == 25000
 
 
+def test_native_loader_bit_identical():
+    """The C++ crop+flip kernel (native/loader.cpp) must produce exactly the
+    numpy fallback's batches for the same rng state — same ys/xs/flip draw
+    order, same strided-copy semantics (flip included)."""
+    from ps_pytorch_tpu.data import augment
+    rng = np.random.default_rng(0)
+    P = rng.integers(0, 256, size=(500, 40, 40, 3), dtype=np.uint8)
+    sel = rng.integers(0, 500, 256)
+    lib = augment._load_native_loader()
+    if lib is None:
+        import pytest
+        pytest.skip("native loader unavailable and unbuildable")
+    r1, r2 = np.random.default_rng(5), np.random.default_rng(5)
+    native = augment.crop_flip_prepadded(P, sel, r1, 32, 32)
+    augment._loader_lib = None
+    try:
+        fallback = augment.crop_flip_prepadded(P, sel, r2, 32, 32)
+    finally:
+        augment._loader_lib = lib
+    np.testing.assert_array_equal(native, fallback)
+    assert native.flags.c_contiguous
+
+
 def test_shard_smaller_than_batch_rejected():
     import pytest
     x = np.zeros((100, 4, 4, 1), np.float32)
