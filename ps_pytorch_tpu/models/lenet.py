@@ -3,8 +3,12 @@
 conv(20->50, 5x5, valid) -> maxpool2 -> relu -> fc(800->500) -> fc(500->classes).
 
 The reference's ``LeNetSplit`` variant (``lenet.py:39-258``) exists only to
-interleave per-layer backward with per-layer MPI sends; under XLA the compiler
-overlaps collectives with compute, so there is deliberately no split variant.
+interleave per-layer backward with per-layer MPI sends; XLA schedules
+collectives against independent compute inside the compiled step, so there
+is deliberately no split variant. (Overlap is the compiler's documented
+scheduling behavior, not yet shown in a multi-chip trace from this repo —
+single-chip psum is a no-op, so the claim is only measurable on a real
+multi-chip slice; see PERF.md §7.)
 """
 
 from typing import Any

@@ -11,8 +11,10 @@ normalizer, flash-attention style), so no device ever materializes the full
 
 Memory per device: O(S/N * d) for K/V plus O(S/N * S/N) per block product;
 communication: (N-1) ppermute hops of the local K/V shard per layer —
-bandwidth-optimal on a ring, and XLA overlaps the permute with the block
-computation inside the scanned loop.
+bandwidth-optimal on a ring. The loop is structured so each hop's permute
+is independent of that iteration's block computation, which lets XLA's
+scheduler overlap them; the overlap itself is not yet trace-verified here
+(needs a real multi-chip slice; PERF.md §7).
 
 Used by ``models/transformer.py``'s sequence-parallel mode; correctness is
 tested against full (unsharded) attention on the 8-device CPU mesh.
