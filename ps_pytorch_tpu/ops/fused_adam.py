@@ -1,11 +1,14 @@
 """Fused Adam / AMSGrad parameter update (Pallas).
 
-Companion to ``ops/fused_sgd.py``: one kernel per parameter buffer performs
-the reference's exact Adam update (``optim/adam.py:38-94``: weight-decay
-fold, biased first/second moments, optional AMSGrad max, torch-style eps
-OUTSIDE the sqrt, bias-corrected step size) in a single HBM read+write pass
-with params and both moment buffers aliased in place. The bias-correction
-scalar is computed host-side per step and fed through SMEM.
+Companion to ``ops/fused_sgd.py``: ONE kernel invocation over a flat
+concatenation of every parameter leaf performs the reference's exact Adam
+update (``optim/adam.py:38-94``: weight-decay fold, biased first/second
+moments, optional AMSGrad max, torch-style eps OUTSIDE the sqrt,
+bias-corrected step size) in a single HBM read+write pass with params and
+both moment buffers aliased in place. The bias-correction scalar is
+computed host-side per step and fed through SMEM. (Flat layout for the
+same reason as fused_sgd: a kernel per leaf pays per-launch overhead that
+swamps the single-pass win at CNN scale.)
 
 Off-TPU the kernel runs in Pallas interpreter mode; golden tests assert
 agreement with ``optim.adam`` (itself a golden transcription of the
@@ -99,6 +102,8 @@ class FusedAdam:
                          max_exp_avg_sq=z() if self.amsgrad else ())
 
     def apply(self, params: Any, state: AdamState, grads: Any):
+        import numpy as np
+
         interpret = self.interpret
         if interpret is None:
             interpret = _interpret_default()
@@ -107,27 +112,30 @@ class FusedAdam:
         lr_t = self.lr(state.step) if callable(self.lr) else self.lr
         step_size = lr_t * jnp.sqrt(1 - self.b2 ** tf) / (1 - self.b1 ** tf)
 
-        def leaf(p, m, v, vh, g):
-            p2d, _ = _pad2d(p)
-            bufs = [p2d, _pad2d(m)[0], _pad2d(v)[0]]
-            if self.amsgrad:
-                bufs.append(_pad2d(vh)[0])
-            bufs.append(_pad2d(g)[0])
-            outs = _fused_update_padded(
-                tuple(bufs), step_size, b1=self.b1, b2=self.b2, eps=self.eps,
-                weight_decay=self.weight_decay, amsgrad=self.amsgrad,
-                interpret=interpret)
-            unflat = lambda a2d: a2d.reshape(-1)[:p.size].reshape(p.shape).astype(p.dtype)
-            outs = [unflat(o) for o in outs]
-            return tuple(outs) if self.amsgrad else (outs[0], outs[1], outs[2], ())
+        leaves_p, treedef = jax.tree.flatten(params)
+        sizes = [int(np.prod(l.shape)) if l.shape else 1 for l in leaves_p]
+        flat = lambda tree: jnp.concatenate(
+            [jnp.ravel(l).astype(jnp.float32)
+             for l in jax.tree.flatten(tree)[0]])
+        bufs = [_pad2d(flat(params))[0], _pad2d(flat(state.exp_avg))[0],
+                _pad2d(flat(state.exp_avg_sq))[0]]
+        if self.amsgrad:
+            bufs.append(_pad2d(flat(state.max_exp_avg_sq))[0])
+        bufs.append(_pad2d(flat(grads))[0])
+        outs = _fused_update_padded(
+            tuple(bufs), step_size, b1=self.b1, b2=self.b2, eps=self.eps,
+            weight_decay=self.weight_decay, amsgrad=self.amsgrad,
+            interpret=interpret)
 
-        # Placeholder leaves (not empty containers — tree structures must
-        # match) when AMSGrad is off; `leaf` never reads them.
-        vh_in = state.max_exp_avg_sq if self.amsgrad \
-            else jax.tree.map(lambda _: 0.0, params)
-        out = jax.tree.map(leaf, params, state.exp_avg, state.exp_avg_sq,
-                           vh_in, grads)
-        is_res = lambda x: isinstance(x, tuple) and len(x) == 4
-        pick = lambda i: jax.tree.map(lambda r: r[i], out, is_leaf=is_res)
-        return pick(0), AdamState(step=t, exp_avg=pick(1), exp_avg_sq=pick(2),
-                                  max_exp_avg_sq=pick(3) if self.amsgrad else ())
+        def unflat(a2d):
+            vec = a2d.reshape(-1)
+            res, off = [], 0
+            for leaf, size in zip(leaves_p, sizes):
+                res.append(vec[off:off + size].reshape(leaf.shape)
+                           .astype(leaf.dtype))
+                off += size
+            return jax.tree.unflatten(treedef, res)
+
+        return unflat(outs[0]), AdamState(
+            step=t, exp_avg=unflat(outs[1]), exp_avg_sq=unflat(outs[2]),
+            max_exp_avg_sq=unflat(outs[3]) if self.amsgrad else ())
