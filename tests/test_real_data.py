@@ -45,6 +45,37 @@ def test_digits_lenet_reaches_90pct_quick():
     assert r["prec1"] >= 0.90, r
 
 
+def test_idx_parser_roundtrip(tmp_path):
+    """read_idx against files written in the IDX format spec — exercises
+    the parser (magic, dims, payload; gz and plain) without real MNIST."""
+    import gzip
+    import struct
+    from ps_pytorch_tpu.data.vision_io import read_idx
+
+    rng = np.random.default_rng(0)
+    imgs = rng.integers(0, 256, size=(7, 5, 4), dtype=np.uint8)
+    raw = struct.pack(">I", 0x00000803) + struct.pack(">3I", 7, 5, 4) \
+        + imgs.tobytes()
+    p = tmp_path / "imgs-idx3-ubyte"
+    p.write_bytes(raw)
+    np.testing.assert_array_equal(read_idx(str(p)), imgs)
+    # gz variant resolved from the bare path
+    pgz = tmp_path / "lbl-idx1-ubyte"
+    labels = np.arange(9, dtype=np.uint8)
+    lraw = struct.pack(">I", 0x00000801) + struct.pack(">I", 9) + labels.tobytes()
+    with gzip.open(str(pgz) + ".gz", "wb") as f:
+        f.write(lraw)
+    np.testing.assert_array_equal(read_idx(str(pgz)), labels)
+    # wrong dtype code -> explicit error
+    bad = tmp_path / "bad-idx"
+    bad.write_bytes(struct.pack(">I", 0x00000D01) + struct.pack(">I", 1) + b"\x00" * 4)
+    with pytest.raises(ValueError, match="IDX dtype"):
+        read_idx(str(bad))
+    # missing file -> actionable FileNotFoundError naming data_prepare
+    with pytest.raises(FileNotFoundError, match="data_prepare"):
+        read_idx(str(tmp_path / "nope-idx3-ubyte"))
+
+
 @pytest.mark.skipif(not os.path.exists("./data/MNIST/raw"),
                     reason="MNIST files not present (pre-download contract)")
 def test_mnist_idx_parser():
