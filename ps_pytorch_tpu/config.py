@@ -80,6 +80,14 @@ class TrainConfig:
     codec_level: int = 3
     grad_codec: str = "blosc"        # blosc (lossless, native C++) | int8 (on-device Pallas)
 
+    # -- LM / long-context surface (train_lm.py; reference has no LM) --
+    lm_vocab: int = 256
+    lm_d_model: int = 128
+    lm_layers: int = 2
+    lm_heads: int = 4
+    lm_seq_len: int = 1024           # sharded over the mesh (ring attention)
+    lm_corpus_tokens: int = 1_000_000
+
     # -- fault injection (tests / straggler drills; SURVEY §5.3: the
     #    reference had none) --
     inject_step_delay: float = 0.0   # seconds of artificial per-step delay

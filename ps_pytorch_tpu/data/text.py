@@ -1,0 +1,93 @@
+"""Token-stream data for LM training (the long-context pipeline).
+
+The reference is CNNs-only; the LM surface is this framework's extension
+(SURVEY §5.7 long context as first-class). Data contract mirrors the image
+loaders: deterministic shared-seed generation, per-host disjoint sharding,
+a prefetch-free ``next_batch`` (token slicing is O(bytes), nothing to hide
+behind compute).
+
+``synthetic_text`` is a learnable corpus: a Markov chain over ``vocab``
+tokens with a strong transition structure, so next-token loss falls well
+below the uniform floor log(vocab) — the convergence oracle for LM tests
+(the image pipeline's class-dependent-means trick, in sequence form).
+"""
+
+from typing import Iterator, Tuple
+
+import numpy as np
+
+
+def synthetic_tokens(n_tokens: int, vocab: int = 256,
+                     seed: int = 0) -> np.ndarray:
+    """Markov stream: from state t, next token is (t + step) % vocab with
+    step drawn from a tiny per-state table — highly predictable (entropy
+    << log vocab) yet not constant."""
+    rng = np.random.default_rng(seed)
+    steps = rng.integers(1, 4, size=vocab)        # per-state jump table
+    noise = rng.random(n_tokens) < 0.05           # 5% uniform glitches
+    glitch = rng.integers(0, vocab, size=n_tokens)
+    out = np.empty(n_tokens, np.int32)
+    t = int(rng.integers(0, vocab))
+    for i in range(n_tokens):
+        t = int(glitch[i]) if noise[i] else (t + int(steps[t])) % vocab
+        out[i] = t
+    return out
+
+
+class TokenLoader:
+    """Contiguous [B, S] windows over a token stream, shared-seed shuffled
+    window order, per-host disjoint shards (the DataLoader discipline)."""
+
+    def __init__(self, tokens: np.ndarray, batch: int, seq_len: int,
+                 seed: int = 0, host_id: int = 0, num_hosts: int = 1,
+                 shuffle: bool = True):
+        if batch % num_hosts:
+            raise ValueError(f"batch {batch} not divisible by {num_hosts} hosts")
+        self.tokens = tokens
+        self.local_batch = batch // num_hosts
+        self.seq_len = seq_len
+        self.seed = seed
+        self.host_id, self.num_hosts = host_id, num_hosts
+        self.shuffle = shuffle
+        n_windows = (len(tokens) - 1) // seq_len
+        if n_windows < batch:
+            raise ValueError(f"{len(tokens)} tokens give {n_windows} windows "
+                             f"< global batch {batch}")
+        self.shard_windows = n_windows // num_hosts
+        self._epoch = 0
+        self._cursor = 0
+
+    def __len__(self) -> int:
+        return self.shard_windows // self.local_batch
+
+    def _order(self, epoch: int) -> np.ndarray:
+        n_windows = (len(self.tokens) - 1) // self.seq_len
+        idx = np.arange(n_windows)
+        if self.shuffle:
+            np.random.default_rng((self.seed, epoch)).shuffle(idx)
+        lo = self.host_id * self.shard_windows
+        return idx[lo:lo + self.shard_windows]
+
+    def _gather(self, sel: np.ndarray) -> np.ndarray:
+        """Window ids -> [len(sel), seq_len] int32 (the one place window
+        framing lives, shared by next_batch and epoch)."""
+        out = np.empty((len(sel), self.seq_len), np.int32)
+        for i, w in enumerate(sel):
+            out[i] = self.tokens[w * self.seq_len:(w + 1) * self.seq_len]
+        return out
+
+    def next_batch(self) -> np.ndarray:
+        """-> [local_batch, seq_len] int32; advances epochs forever."""
+        if self._cursor + self.local_batch > self.shard_windows:
+            self._epoch += 1
+            self._cursor = 0
+        order = self._order(self._epoch)
+        sel = order[self._cursor:self._cursor + self.local_batch]
+        self._cursor += self.local_batch
+        return self._gather(sel)
+
+    def epoch(self, epoch: int) -> Iterator[np.ndarray]:
+        order = self._order(epoch)
+        for b in range(len(self)):
+            yield self._gather(
+                order[b * self.local_batch:(b + 1) * self.local_batch])

@@ -56,6 +56,31 @@ def create_lm_train_state(model, tx, mesh: Mesh, sample_tokens,
     return jax.jit(init_fn, out_shardings=shardings)(rng)
 
 
+def _local_nexttoken_loss(model, axis_name: str, params, tokens):
+    """Per-shard next-token loss (sum, count) — shared by the train step and
+    the grad-free eval so their framing can never diverge.
+
+    LOCAL sums only — no collective inside (the train step differentiates
+    this; differentiating through an in-loss psum double-counts cross-shard
+    cotangents); normalization and the cross-shard sum happen outside.
+    """
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    s_local = tokens.shape[1]
+    positions = idx * s_local + jnp.arange(s_local)
+    logits = model.apply({"params": params}, tokens, positions=positions)
+    # Next-token targets: local shift; the boundary target (first token of
+    # the next shard) arrives via one ppermute hop.
+    perm = [(j, (j - 1) % n) for j in range(n)]
+    first_next = jax.lax.ppermute(tokens[:, :1], axis_name, perm)
+    targets = jnp.concatenate([tokens[:, 1:], first_next], axis=1)
+    per_tok = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+    # The global last token has no target: weight it out.
+    is_global_last = positions == (n * s_local - 1)
+    w = jnp.where(is_global_last, 0.0, 1.0)[None, :]
+    return jnp.sum(per_tok * w), jnp.sum(w) * tokens.shape[0]
+
+
 def make_sp_train_step(model, tx, mesh: Mesh, *, axis_name: str = "data",
                        donate: bool = True) -> Callable:
     """-> step_fn(state, tokens) -> (state, metrics).
@@ -67,31 +92,8 @@ def make_sp_train_step(model, tx, mesh: Mesh, *, axis_name: str = "data",
     """
 
     def local_step(state, tokens):
-        n = jax.lax.axis_size(axis_name)
-        idx = jax.lax.axis_index(axis_name)
-        s_local = tokens.shape[1]
-        positions = idx * s_local + jnp.arange(s_local)
-
         def loss_fn(params):
-            # LOCAL loss sum only — no collective inside the differentiated
-            # function (differentiating through an in-loss psum double-counts
-            # cross-shard cotangents); normalization and the cross-shard sum
-            # happen on the gradient afterwards.
-            logits = model.apply({"params": params}, tokens,
-                                 positions=positions)
-            # Next-token targets: local shift; the boundary target (first
-            # token of the next shard) arrives via one ppermute hop.
-            perm = [(j, (j - 1) % n) for j in range(n)]
-            first_next = jax.lax.ppermute(tokens[:, :1], axis_name, perm)
-            targets = jnp.concatenate([tokens[:, 1:], first_next], axis=1)
-            per_tok = optax.softmax_cross_entropy_with_integer_labels(
-                logits, targets)
-            # The global last token has no target: weight it out.
-            is_global_last = positions == (n * s_local - 1)
-            w = jnp.where(is_global_last, 0.0, 1.0)[None, :]
-            loss_sum = jnp.sum(per_tok * w)
-            count = jnp.sum(w) * tokens.shape[0]
-            return loss_sum, count
+            return _local_nexttoken_loss(model, axis_name, params, tokens)
 
         (loss_sum, count), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(state.params)
@@ -116,3 +118,26 @@ def make_sp_train_step(model, tx, mesh: Mesh, *, axis_name: str = "data",
         out_specs=(specs, P()),
         check_vma=False)
     return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+
+
+def make_sp_eval_fn(model, mesh: Mesh, *, axis_name: str = "data") -> Callable:
+    """-> eval_fn(params, tokens) -> mean next-token loss (scalar).
+
+    Grad-free forward through the SAME sharded ring-attention path as the
+    train step (shared loss framing, `_local_nexttoken_loss`) — evaluating
+    with a full-attention clone at the global sequence length would
+    materialize the [S, S] score matrix on one device, the exact OOM the
+    long-context design exists to avoid."""
+
+    def local_eval(params, tokens):
+        loss_sum, count = _local_nexttoken_loss(model, axis_name, params,
+                                                tokens)
+        return jax.lax.psum(loss_sum, axis_name) / \
+            jax.lax.psum(count, axis_name)
+
+    sharded = jax.shard_map(
+        local_eval, mesh=mesh,
+        in_specs=(P(), P(None, axis_name)),
+        out_specs=P(),
+        check_vma=False)
+    return jax.jit(sharded)

@@ -1,0 +1,169 @@
+"""LM trainer — long-context training through the standard runtime contract.
+
+Drives ``models/transformer.TransformerLM`` with the sequence-parallel step
+(``parallel/sp.py``: sequence sharded over the mesh, ring attention when
+more than one device is present) while reusing the framework's standard
+machinery: TrainConfig, MetricsLogger STEP schema, atomic checkpoints with
+resume, and the evaluator's held-out oracle (here: next-token loss /
+perplexity on a disjoint tail of the stream).
+
+The reference has no LM surface at all — this is the §5.7 long-context
+capability expressed as a first-class entry point (``train_lm.py``), not
+just library code.
+"""
+
+import json
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ps_pytorch_tpu.config import TrainConfig
+from ps_pytorch_tpu.data.text import TokenLoader, synthetic_tokens
+from ps_pytorch_tpu.models.transformer import TransformerLM
+from ps_pytorch_tpu.optim import build_schedule
+from ps_pytorch_tpu.optim.sgd import sgd
+from ps_pytorch_tpu.parallel import dist
+from ps_pytorch_tpu.parallel.sp import (
+    create_lm_train_state, make_sp_eval_fn, make_sp_train_step,
+)
+from ps_pytorch_tpu.runtime import checkpoint as ckpt
+from ps_pytorch_tpu.runtime.metrics import MetricsLogger
+
+
+class LMTrainer:
+    def __init__(self, cfg: TrainConfig):
+        self.cfg = cfg
+        devices = jax.devices()
+        self.mesh = Mesh(np.array(devices), ("data",))
+        impl = "ring" if len(devices) > 1 else "full"
+        if cfg.lm_seq_len % len(devices):
+            raise ValueError(f"lm_seq_len {cfg.lm_seq_len} not divisible by "
+                             f"{len(devices)} devices (sequence sharding)")
+        self.model = TransformerLM(
+            vocab_size=cfg.lm_vocab, d_model=cfg.lm_d_model,
+            n_layers=cfg.lm_layers, n_heads=cfg.lm_heads,
+            max_seq_len=cfg.lm_seq_len, attention_impl=impl,
+            axis_name="data")
+        # The SP step consumes an optax transform (tx.update); the fused
+        # Pallas optimizers (apply-style) are a CNN-step dispatch — use the
+        # plain golden-tested transform here regardless of the flag.
+        self.tx = sgd(lr=build_schedule(cfg), momentum=cfg.momentum,
+                      weight_decay=cfg.weight_decay, nesterov=cfg.nesterov)
+        self.state = create_lm_train_state(
+            self.model, self.tx, self.mesh,
+            (cfg.batch_size, cfg.lm_seq_len), jax.random.key(cfg.seed))
+        self.step_fn = make_sp_train_step(self.model, self.tx, self.mesh,
+                                          donate=cfg.donate)
+        self.eval_fn = make_sp_eval_fn(self.model, self.mesh)
+
+        stream = synthetic_tokens(cfg.lm_corpus_tokens, cfg.lm_vocab,
+                                  seed=cfg.seed)
+        # Held-out tail: last 10% of the stream never trains.
+        cut = len(stream) - max(len(stream) // 10,
+                                (cfg.batch_size + 1) * cfg.lm_seq_len + 1)
+        self.train_loader = TokenLoader(stream[:cut], cfg.batch_size,
+                                        cfg.lm_seq_len, seed=cfg.seed)
+        self.val_tokens = stream[cut:]
+        self.metrics = MetricsLogger(cfg.metrics_file, cfg.log_every)
+        self.start_step = 0
+
+    # ---- checkpoint/resume (same on-disk contract as the CNN Trainer) ----
+    def _checkpoint(self, step: int) -> None:
+        # Checkpoint authority stays with the leader (trainer.py does the
+        # same): concurrent writers to a shared train_dir would race.
+        if jax.process_index() != 0:
+            return
+        ckpt.save_checkpoint(self.cfg.train_dir, step,
+                             jax.device_get(self.state),
+                             config_json=self.cfg.to_json(),
+                             compress=self.cfg.compress_grad,
+                             codec_level=self.cfg.codec_level)
+
+    def maybe_resume(self) -> bool:
+        step = ckpt.latest_step(self.cfg.train_dir)
+        if step is None:
+            return False
+        try:
+            state, meta, config_json = ckpt.load_checkpoint(
+                self.cfg.train_dir, step, jax.device_get(self.state))
+        except Exception as e:
+            # Most likely a non-LM (CNN) checkpoint sharing the default
+            # ./train_dir — surface that instead of a msgpack key error.
+            raise ValueError(
+                f"could not restore step {step} from {self.cfg.train_dir} "
+                f"into the LM state (a train.py checkpoint in the same "
+                f"train_dir? use a separate --train-dir or --no-resume): "
+                f"{type(e).__name__}: {e}") from e
+        # A CNN checkpoint in the same train_dir would fail deep inside
+        # deserialization; check the saved config's model geometry first
+        # and fail with an actionable message instead.
+        try:
+            saved = json.loads(config_json)
+        except (TypeError, ValueError):
+            saved = {}
+        for k in ("lm_vocab", "lm_d_model", "lm_layers", "lm_heads"):
+            if k in saved and saved[k] != getattr(self.cfg, k):
+                raise ValueError(
+                    f"checkpoint in {self.cfg.train_dir} was written with "
+                    f"{k}={saved[k]} but this run uses "
+                    f"{getattr(self.cfg, k)} — wrong train_dir, or pass "
+                    f"--no-resume / a fresh --train-dir")
+        self.state = jax.device_put(state)
+        self.start_step = int(meta["step"])
+        print(f"RESUME lm at step {self.start_step}")
+        return True
+
+    def train(self):
+        cfg = self.cfg
+        if cfg.resume:
+            self.maybe_resume()
+        step = self.start_step
+        while step < cfg.max_steps:
+            step += 1
+            t0 = time.monotonic()
+            tokens = self.train_loader.next_batch()
+            t_data = time.monotonic() - t0
+            # Every process generates the identical shared-seed batch; the
+            # globalize places each host's sequence shard (multi-process
+            # safe — a host-local committed array can't feed a multi-host
+            # shard_map).
+            tok_g = dist.globalize_replicated(self.mesh, tokens,
+                                              spec=P(None, "data"))
+            self.state, m = self.step_fn(self.state, tok_g)
+            if step % cfg.log_every == 0 or step == cfg.max_steps:
+                loss = float(m["loss"])
+                self.metrics.log_step(step, self.train_loader._epoch,
+                                      loss=loss, acc=0.0, participating=1.0,
+                                      step_time=time.monotonic() - t0,
+                                      data_time=t_data)
+            if cfg.eval_freq > 0 and step % cfg.eval_freq == 0:
+                self._checkpoint(step)
+        jax.block_until_ready(self.state.params)
+        if cfg.eval_freq > 0 and step % cfg.eval_freq != 0:
+            self._checkpoint(step)
+        self.metrics.close()
+        return self.state
+
+    def evaluate(self, max_batches: Optional[int] = None) -> dict:
+        """Held-out next-token loss + perplexity (the LM analogue of the
+        evaluator's Prec@1 oracle), through the SAME sharded ring-attention
+        forward as training — a full-attention clone at the global sequence
+        length would materialize the [S, S] scores on one device, the OOM
+        the long-context design exists to avoid."""
+        cfg = self.cfg
+        val = TokenLoader(self.val_tokens, cfg.batch_size, cfg.lm_seq_len,
+                          seed=0, shuffle=False)
+        losses = []
+        for i, tokens in enumerate(val.epoch(0)):
+            if max_batches is not None and i >= max_batches:
+                break
+            tok_g = dist.globalize_replicated(self.mesh, tokens,
+                                              spec=P(None, "data"))
+            losses.append(float(self.eval_fn(self.state.params, tok_g)))
+        loss = float(np.mean(losses)) if losses else float("nan")
+        return {"loss": loss, "perplexity": float(np.exp(min(loss, 30.0))),
+                "batches": len(losses)}

@@ -1,0 +1,58 @@
+"""LM entry point (runtime/lm_trainer.py, train_lm.py): long-context
+training through the standard config/checkpoint/metrics contract, on the
+8-device CPU mesh (ring attention, sequence sharded)."""
+
+import numpy as np
+import pytest
+
+from ps_pytorch_tpu.config import TrainConfig
+from ps_pytorch_tpu.data.text import TokenLoader, synthetic_tokens
+
+
+def _cfg(tmp_path, **kw):
+    base = dict(batch_size=8, lr=0.3, momentum=0.9, max_steps=40,
+                eval_freq=0, log_every=100, lm_seq_len=128,
+                lm_d_model=64, lm_layers=2, lm_heads=4,
+                lm_corpus_tokens=120_000, train_dir=str(tmp_path))
+    base.update(kw)
+    return TrainConfig(**base)
+
+
+def test_token_loader_shards_disjoint_and_shapes():
+    toks = synthetic_tokens(50_000, vocab=64, seed=3)
+    l0 = TokenLoader(toks, 8, 128, seed=1, host_id=0, num_hosts=2)
+    l1 = TokenLoader(toks, 8, 128, seed=1, host_id=1, num_hosts=2)
+    assert set(l0._order(0)).isdisjoint(l1._order(0))
+    b = l0.next_batch()
+    assert b.shape == (4, 128) and b.dtype == np.int32
+
+
+def test_token_loader_rejects_bad_geometry():
+    toks = synthetic_tokens(1_000, vocab=16)
+    with pytest.raises(ValueError):
+        TokenLoader(toks, 7, 128, num_hosts=2)      # divisibility
+    with pytest.raises(ValueError):
+        TokenLoader(toks, 512, 128)                 # too few windows
+
+
+def test_lm_trains_below_uniform_floor_and_evaluates(tmp_path):
+    """Next-token loss on the Markov stream must fall far below the
+    uniform floor log(vocab) and generalize to the held-out tail."""
+    from ps_pytorch_tpu.runtime.lm_trainer import LMTrainer
+
+    t = LMTrainer(_cfg(tmp_path))
+    t.train()
+    r = t.evaluate(max_batches=4)
+    assert r["loss"] < 0.4 * np.log(256), r
+    assert r["perplexity"] < 256 ** 0.4
+
+
+def test_lm_checkpoint_resume(tmp_path):
+    from ps_pytorch_tpu.runtime.lm_trainer import LMTrainer
+
+    cfg = _cfg(tmp_path, max_steps=10, eval_freq=5)
+    LMTrainer(cfg).train()
+    t2 = LMTrainer(cfg.replace(max_steps=12))
+    t2.train()
+    assert t2.start_step == 10          # resumed, not retrained
+    assert int(t2.state.step) == 12
