@@ -106,6 +106,7 @@ def bench_throughput(name, network, dataset, per_device_batch, steps, **kw):
     ips = batch / sec_per_step
     base = BASELINES.get(name)
     return {"config": name, "network": network, "dataset": dataset,
+            "platform": jax.devices()[0].platform,
             "devices": n_dev, "global_batch": batch,
             "sec_per_step": round(sec_per_step, 5),
             "images_per_sec": round(ips, 1),
@@ -144,6 +145,10 @@ def bench_input_pipeline(name, dataset, per_device_batch, steps):
     dt = time.perf_counter() - t0
     ips = n_img / dt
     return {"config": name, "dataset": dataset, "global_batch": batch,
+            # The loader is HOST-side by design: its throughput is valid
+            # whatever backend jax resolved to; the ratio row pairs it with
+            # the chip row's platform.
+            "platform": "host",
             "loader_images_per_sec": round(ips, 1),
             "augment": "pad4+crop+flip" +
                        ("" if dev_norm else "+normalize"),
@@ -217,7 +222,8 @@ def bench_async_multislice(name, steps, *, network="ResNet18",
     jax.block_until_ready(t.params)
     dt = (time.perf_counter() - t0) / steps
     imgs = per_slice_batch * n_slices
-    return {"config": name, "network": network, "n_slices": n_slices,
+    return {"config": name, "network": network,
+            "platform": jax.devices()[0].platform, "n_slices": n_slices,
             "per_slice_batch": per_slice_batch,
             "sec_per_tick": round(dt, 5),
             "images_per_sec": round(imgs / dt, 1),
@@ -266,7 +272,8 @@ def bench_transformer_lm(name, steps, *, batch=8, seq_len=2048, d_model=512,
     jax.block_until_ready(state.params)
     dt = (time.perf_counter() - t0) / steps
     toks = batch * seq_len
-    return {"config": name, "attention": impl, "devices": n,
+    return {"config": name, "attention": impl,
+            "platform": jax.devices()[0].platform, "devices": n,
             "batch": batch, "seq_len": seq_len, "d_model": d_model,
             "n_layers": n_layers,
             "sec_per_step": round(dt, 5),
@@ -298,6 +305,7 @@ def bench_time_to_loss(name, network, dataset, batch, target_loss,
     loss = float(m["loss"])
     dt = time.perf_counter() - t0
     return {"config": name, "network": network, "dataset": dataset,
+            "platform": jax.devices()[0].platform,
             "target_loss": target_loss, "reached_loss": round(loss, 4),
             "steps": i + 1, "seconds": round(dt, 3),
             "converged": loss <= target_loss}
