@@ -3,9 +3,12 @@
 A ground-up JAX/XLA/pjit/Pallas re-design of the capabilities of the reference
 parameter-server system ``bapi/ps_pytorch`` (see SURVEY.md at the repo root):
 synchronous / asynchronous data-parallel SGD for LeNet / ResNet / VGG on
-MNIST / CIFAR-10 / CIFAR-100 / SVHN, with K-of-N backup-worker straggler
-mitigation, gradient compression at DCN boundaries, checkpoint-and-poll
-evaluation, and pod launch tooling.
+MNIST / CIFAR-10 / CIFAR-100 / SVHN / Digits (real, zero-egress), with K-of-N
+backup-worker straggler mitigation, gradient compression at DCN boundaries
+(lossless C++ codec or on-device Pallas int8), ZeRO-1 sharded updates,
+checkpoint-and-poll evaluation, long-context LM training via ring attention
+(``train_lm.py``), a native C++ loader core, and pod provisioning + launch
+tooling.
 
 Design (vs. the reference's master/worker MPI loop,
 ``sync_replicas_master_nn.py:133-197`` / ``distributed_worker.py:104-180``):
