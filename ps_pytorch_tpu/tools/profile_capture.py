@@ -80,36 +80,62 @@ def convert(xplane: str, out_dir: str) -> dict:
 
 
 def digest(outputs: dict) -> dict:
-    """Pull the headline numbers out of the tool JSONs (schema-tolerant)."""
+    """Pull the headline numbers out of the tool JSONs.
+
+    framework_op_stats is a list of gviz tables (device + host rows mixed;
+    `host_or_device` distinguishes). Emitted per device op: self time, % of
+    device time, bound-by classification, memory BW — the inputs PERF.md §7
+    needs (MXU-busy vs elementwise vs idle fractions)."""
     d = {}
     path = outputs.get("framework_op_stats")
-    if path:
-        try:
-            tbl = json.load(open(path))
-            # gviz table: {cols: [...], rows: [{c: [{v:..}..]}..]} or a list.
-            if isinstance(tbl, list):
-                tbl = tbl[0]
-            cols = [c.get("label") or c.get("id") for c in tbl["cols"]]
-            rows = [[cell.get("v") if isinstance(cell, dict) else cell
-                     for cell in r["c"]] for r in tbl["rows"]]
+    if not path:
+        return d
+    try:
+        tables = json.load(open(path))
+        if not isinstance(tables, list):
+            tables = [tables]
+        def collect(side):
+            rows, totals = [], {}
+            for tbl in tables:
+                _collect_table(tbl, side, rows, totals)
+            return rows, totals
 
-            def col(label_part):
-                for i, c in enumerate(cols):
-                    if c and label_part.lower() in str(c).lower():
-                        return i
-                return None
-            i_name, i_self = col("operation"), col("total self")
-            i_type = col("type")
-            if i_name is None:
-                i_name = col("op name")
-            if i_self is not None and i_name is not None:
-                rows.sort(key=lambda r: -(r[i_self] or 0))
-                d["top_ops_by_self_time"] = [
-                    {"op": r[i_name], "self": r[i_self],
-                     **({"type": r[i_type]} if i_type is not None else {})}
-                    for r in rows[:15]]
-        except Exception as e:
-            d["op_stats_parse_error"] = f"{type(e).__name__}: {e}"[:200]
+        def _collect_table(tbl, side, out_rows, totals):
+            ids = [c.get("id") for c in tbl["cols"]]
+            idx = {k: ids.index(k) for k in
+                   ("host_or_device", "type", "operation", "occurrences",
+                    "total_self_time", "device_total_self_time_percent",
+                    "bound_by", "measured_memory_bw", "model_flop_rate")
+                   if k in ids}
+            for r in tbl["rows"]:
+                cells = [c.get("v") if isinstance(c, dict) else c
+                         for c in r["c"]]
+                if cells[idx.get("host_or_device", 1)] != side:
+                    continue
+                row = {k: cells[i] for k, i in idx.items()}
+                out_rows.append(row)
+                cat = row.get("type") or "?"
+                totals[cat] = totals.get(cat, 0.0) + \
+                    (row.get("total_self_time") or 0.0)
+
+        dev_rows, cat_totals = collect("Device")
+        side = "Device"
+        if not dev_rows:       # CPU-backend traces file everything as Host
+            dev_rows, cat_totals = collect("Host")
+            side = "Host"
+        dev_rows.sort(key=lambda r: -(r.get("total_self_time") or 0))
+        d["op_stats_side"] = side
+        d["device_category_self_time_us"] = dict(
+            sorted(cat_totals.items(), key=lambda kv: -kv[1]))
+        d["top_device_ops"] = [
+            {"op": r.get("operation"), "type": r.get("type"),
+             "self_us": r.get("total_self_time"),
+             "pct": r.get("device_total_self_time_percent"),
+             "bound_by": r.get("bound_by"),
+             "mem_bw_gbps": r.get("measured_memory_bw")}
+            for r in dev_rows[:15]]
+    except Exception as e:
+        d["op_stats_parse_error"] = f"{type(e).__name__}: {e}"[:200]
     return d
 
 
