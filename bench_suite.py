@@ -175,6 +175,16 @@ def bench_quantizer(name, steps):
         q = quantize_int8(x, keys[i % 32])
     jax.block_until_ready(q.values)
     dt_q = (time.perf_counter() - t0) / steps
+    # Per-call BLOCKING latency alongside pipelined throughput: through a
+    # remote-tunnel backend the two diverge by the dispatch RTT, so the
+    # artifact itself shows whether a low GB/s figure is kernel time or
+    # link latency (r3: suite once read 8.7 GB/s in a dying tunnel window
+    # vs 413 GB/s healthy).
+    t0 = time.perf_counter()
+    for i in range(min(steps, 5)):
+        q = quantize_int8(x, keys[i % 32])
+        jax.block_until_ready(q.values)
+    dt_block = (time.perf_counter() - t0) / min(steps, 5)
     t0 = time.perf_counter()
     for _ in range(steps):
         y = dequantize_int8(q)
@@ -186,6 +196,7 @@ def bench_quantizer(name, steps):
             "wire_bytes": quantized_nbytes(q),
             "shrink": round(nbytes / quantized_nbytes(q), 2),
             "quantize_ms": round(dt_q * 1e3, 3),
+            "quantize_blocking_ms": round(dt_block * 1e3, 3),
             "dequantize_ms": round(dt_d * 1e3, 3),
             "quantize_gbps": round(nbytes / dt_q / 1e9, 1),
             "max_abs_err": round(err, 5),

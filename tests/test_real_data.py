@@ -76,6 +76,22 @@ def test_idx_parser_roundtrip(tmp_path):
         read_idx(str(tmp_path / "nope-idx3-ubyte"))
 
 
+def test_svhn_mat_parser(tmp_path):
+    """load_svhn against a spec-shaped .mat: HWCN->NHWC transpose and the
+    '0 stored as 10' label remap, no real SVHN download needed."""
+    from scipy.io import savemat
+    from ps_pytorch_tpu.data.vision_io import load_svhn
+
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, 256, size=(32, 32, 3, 6), dtype=np.uint8)  # HWCN
+    y = np.array([[1], [2], [10], [5], [10], [9]], dtype=np.uint8)
+    savemat(str(tmp_path / "train_32x32.mat"), {"X": x, "y": y})
+    got_x, got_y = load_svhn(str(tmp_path), train=True)
+    assert got_x.shape == (6, 32, 32, 3)
+    np.testing.assert_array_equal(got_x[3], x[..., 3])
+    np.testing.assert_array_equal(got_y, [1, 2, 0, 5, 0, 9])
+
+
 @pytest.mark.skipif(not os.path.exists("./data/MNIST/raw"),
                     reason="MNIST files not present (pre-download contract)")
 def test_mnist_idx_parser():
