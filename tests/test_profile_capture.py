@@ -1,0 +1,53 @@
+"""Trace-digest parsing (tools/profile_capture.py) against a canned gviz
+table in the framework_op_stats schema — locks the column-id contract the
+TPU-run digest depends on, with no trace capture needed."""
+
+import json
+
+from ps_pytorch_tpu.tools.profile_capture import digest
+
+
+def _gviz(rows):
+    ids = ["rank", "host_or_device", "type", "operation", "occurrences",
+           "total_time", "avg_time", "total_self_time", "avg_self_time",
+           "device_total_self_time_percent",
+           "device_cumulative_total_self_time_percent",
+           "host_total_self_time_percent",
+           "Host_cumulative_total_self_time_percent", "measured_flop_rate",
+           "model_flop_rate", "measured_memory_bw", "operational_intensity",
+           "bound_by", "eager"]
+    return {"cols": [{"id": i, "label": i, "type": "number"} for i in ids],
+            "rows": [{"c": [{"v": v} for v in r]} for r in rows]}
+
+
+def _row(side, typ, op, self_us, pct, bw=100.0, bound="memory"):
+    return [1.0, side, typ, op, 3.0, self_us + 1, 1.0, self_us, 1.0, pct,
+            0.0, 0.0, 0.0, 0.0, 0.0, bw, 1.0, bound, "compiled"]
+
+
+def test_digest_aggregates_device_categories(tmp_path):
+    tbl = [_gviz([
+        _row("Device", "convolution", "conv.1", 900.0, 45.0),
+        _row("Device", "convolution", "conv.2", 500.0, 25.0),
+        _row("Device", "fusion", "fusion.7", 300.0, 15.0),
+        _row("Host", "infeed", "hostop", 9999.0, 0.0),   # must be excluded
+    ])]
+    p = tmp_path / "framework_op_stats.json"
+    p.write_text(json.dumps(tbl))
+    d = digest({"framework_op_stats": str(p)})
+    assert d["op_stats_side"] == "Device"
+    cats = d["device_category_self_time_us"]
+    assert cats["convolution"] == 1400.0 and cats["fusion"] == 300.0
+    assert "infeed" not in cats
+    top = d["top_device_ops"]
+    assert top[0]["op"] == "conv.1" and top[0]["pct"] == 45.0
+    assert top[0]["bound_by"] == "memory"
+
+
+def test_digest_host_fallback_when_no_device_rows(tmp_path):
+    tbl = [_gviz([_row("Host", "IDLE", "IDLE", 0.0, 0.0)])]
+    p = tmp_path / "framework_op_stats.json"
+    p.write_text(json.dumps(tbl))
+    d = digest({"framework_op_stats": str(p)})
+    assert d["op_stats_side"] == "Host"
+    assert d["top_device_ops"][0]["op"] == "IDLE"

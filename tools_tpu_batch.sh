@@ -6,11 +6,15 @@
 cd /root/repo || exit 1
 timeout 90 python -c "import jax; d=jax.devices()[0]; assert d.platform=='tpu', d" || exit 7
 set -x
-python bench_suite.py --steps 20 --markdown BENCH_SUITE_r03.md \
-    > BENCH_SUITE_r03.json 2>/tmp/suite_err.log
-python -m ps_pytorch_tpu.tools.profile_capture --out ./profile_r03 \
+# Ordered smallest/highest-value first: if the tunnel dies mid-batch, the
+# trace (~2 min) and the headline+extras (~6 min) land before the full
+# suite (~15 min) and the accuracy run.
+timeout 900 python -m ps_pytorch_tpu.tools.profile_capture --out ./profile_r03 \
     > /tmp/profile_digest.json 2>/tmp/profile_err.log
-python -m ps_pytorch_tpu.tools.accuracy_run --out ACCURACY_r03.json \
+timeout 1200 python bench.py > /tmp/bench_headline.json 2>/tmp/bench_err.log
+timeout 2400 python bench_suite.py --steps 20 --markdown BENCH_SUITE_r03.md \
+    > BENCH_SUITE_r03.json.new 2>/tmp/suite_err.log \
+  && mv BENCH_SUITE_r03.json.new BENCH_SUITE_r03.json
+timeout 1200 python -m ps_pytorch_tpu.tools.accuracy_run --out ACCURACY_r03.json \
     > /tmp/acc_tpu.log 2>&1
-python bench.py > /tmp/bench_headline.json 2>/tmp/bench_err.log
 echo TPU_BATCH_DONE
