@@ -293,26 +293,35 @@ def bench_transformer_lm(name, steps, *, batch=8, seq_len=2048, d_model=512,
 
 
 def bench_time_to_loss(name, network, dataset, batch, target_loss,
-                       max_steps=200):
+                       max_steps=400):
     """Convergence probe: wall-clock to reach target training loss on a
     learnable synthetic task (the evaluator-accuracy contract's fast proxy)."""
     # lr=0.02: random-label memorization diverges at the throughput rows'
     # lr=0.1 (loss spikes to ~60 then plateaus at chance — observed on v5e).
-    state, step_fn, x, y, mask = _build(network, dataset, batch,
-                                        dtype="float32", lr=0.02)
-    # Warmup/compile outside the clock. The step donates its input state, so
-    # continue from the warmed-up state rather than reusing donated buffers.
-    state, m = step_fn(state, x, y, mask, jax.random.key(0))
-    jax.block_until_ready(state.params)
-    t0 = time.perf_counter()
-    # Loss is checked EVERY step so converged-at-step-N is exact (a 10-step
-    # check stride reported up to 9 steps late — VERDICT r2 weak #8). The
-    # per-step device sync this forces is acceptable: this row measures
-    # convergence, not pipelined throughput (the *_dp rows measure that).
-    for i in range(max_steps):
-        state, m = step_fn(state, x, y, mask, jax.random.key(1 + i))
-        if float(m["loss"]) <= target_loss:
-            break
+    # Matmul precision is pinned to f32: on TPU the default (bf16 passes
+    # even for f32 inputs) left the same probe stuck at chance loss (2.32
+    # after 200 steps, first r3 suite run) while CPU converged by step 120 —
+    # random-label memorization has no margin for matmul noise in its
+    # unstable early phase. Throughput rows keep the hardware default; this
+    # row measures convergence, so exactness wins over speed.
+    with jax.default_matmul_precision("highest"):
+        state, step_fn, x, y, mask = _build(network, dataset, batch,
+                                            dtype="float32", lr=0.02)
+        # Warmup/compile outside the clock. The step donates its input
+        # state, so continue from the warmed-up state rather than reusing
+        # donated buffers.
+        state, m = step_fn(state, x, y, mask, jax.random.key(0))
+        jax.block_until_ready(state.params)
+        t0 = time.perf_counter()
+        # Loss is checked EVERY step so converged-at-step-N is exact (a
+        # 10-step check stride reported up to 9 steps late — VERDICT r2
+        # weak #8). The per-step device sync this forces is acceptable:
+        # this row measures convergence, not pipelined throughput (the
+        # *_dp rows measure that).
+        for i in range(max_steps):
+            state, m = step_fn(state, x, y, mask, jax.random.key(1 + i))
+            if float(m["loss"]) <= target_loss:
+                break
     loss = float(m["loss"])
     dt = time.perf_counter() - t0
     return {"config": name, "network": network, "dataset": dataset,

@@ -35,8 +35,15 @@ class Block(nn.Module):
         h = self.n_heads
         hd = d // h
         y = nn.LayerNorm(dtype=self.dtype)(x)
-        qkv = nn.Dense(3 * d, use_bias=False, dtype=self.dtype)(y)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
+        # Separate q/k/v projections (not one packed Dense(3d)): under
+        # tensor parallelism each kernel's OUTPUT dim is sharded over
+        # 'model', and with per-projection kernels a shard's slice is
+        # head-aligned (d = heads*hd), so attention can stay shard-local; a
+        # packed qkv kernel puts shard boundaries inside q/k/v
+        # (parallel/tp.py layout table).
+        q = nn.Dense(d, use_bias=False, dtype=self.dtype)(y)
+        k = nn.Dense(d, use_bias=False, dtype=self.dtype)(y)
+        v = nn.Dense(d, use_bias=False, dtype=self.dtype)(y)
         to_heads = lambda t: t.reshape(b, s, h, hd).transpose(0, 2, 1, 3)
         q, k, v = to_heads(q), to_heads(k), to_heads(v)
         if self.attention_impl == "ring":

@@ -1,0 +1,124 @@
+"""Tensor-parallel (GSPMD) train step: spec placement + exact equivalence.
+
+The TP step must be the SAME training program as an unsharded step — only
+the placement differs. So the oracle is a plain single-device jit of the
+identical math, compared step-for-step (loss) and at the end (params).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from ps_pytorch_tpu.models.transformer import TransformerLM
+from ps_pytorch_tpu.optim.sgd import sgd
+from ps_pytorch_tpu.parallel.dp import TrainState
+from ps_pytorch_tpu.parallel.mesh import make_mesh
+from ps_pytorch_tpu.parallel.tp import (
+    create_tp_train_state, make_tp_train_step, tp_param_specs, tp_state_specs,
+)
+
+
+def _model(**kw):
+    kw.setdefault("vocab_size", 64)
+    kw.setdefault("n_layers", 2)
+    kw.setdefault("n_heads", 4)
+    kw.setdefault("d_model", 64)
+    kw.setdefault("max_seq_len", 32)
+    return TransformerLM(**kw)
+
+
+def test_tp_param_specs_layout():
+    model = _model()
+    params = model.init(jax.random.key(0), jnp.zeros((2, 16), jnp.int32),
+                        positions=jnp.arange(16))["params"]
+    specs = tp_param_specs(params)
+    b0 = specs["block_0"]
+    for i in (0, 1, 2):                                  # q/k/v col-parallel
+        assert b0[f"Dense_{i}"]["kernel"] == P(None, "model")
+    assert b0["Dense_3"]["kernel"] == P("model", None)   # attn-out row
+    assert b0["Dense_4"]["kernel"] == P(None, "model")   # mlp up col
+    assert b0["Dense_4"]["bias"] == P("model")
+    assert b0["Dense_5"]["kernel"] == P("model", None)   # mlp down row
+    assert b0["Dense_5"]["bias"] == P()                  # replicated bias
+    assert specs["lm_head"]["kernel"] == P(None, "model")
+    assert specs["tok_embed"]["embedding"] == P()
+    assert b0["LayerNorm_0"]["scale"] == P()
+
+
+def test_tp_opt_state_mirrors_param_specs():
+    model = _model()
+    tx = sgd(lr=0.1, momentum=0.9)
+
+    def init_fn(rng):
+        params = model.init(rng, jnp.zeros((2, 16), jnp.int32),
+                            positions=jnp.arange(16))["params"]
+        return TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                          opt_state=tx.init(params), batch_stats={})
+
+    shapes = jax.eval_shape(init_fn, jax.random.key(0))
+    specs = tp_state_specs(shapes)
+    flat_p = jax.tree.leaves(specs.params,
+                             is_leaf=lambda x: isinstance(x, P))
+    flat_o = [s for s in jax.tree.leaves(
+        specs.opt_state, is_leaf=lambda x: isinstance(x, P))]
+    # momentum trace mirrors the param tree: every param spec appears in the
+    # opt specs (trace leaves), sharded ones included.
+    sharded_p = [s for s in flat_p if s != P()]
+    sharded_o = [s for s in flat_o if s != P()]
+    assert sharded_p and sorted(map(str, sharded_p)) == \
+        sorted(map(str, sharded_o))
+
+
+@pytest.mark.parametrize("data,model_ax", [(2, 4), (1, 8)])
+def test_tp_step_matches_unsharded(data, model_ax):
+    mesh = make_mesh(data=data, model=model_ax)
+    model = _model()
+    tx = sgd(lr=0.1, momentum=0.9, weight_decay=1e-4)
+    rng = jax.random.key(7)
+    batch, seq = 8, 32
+    state = create_tp_train_state(model, tx, mesh, (batch, seq), rng)
+    step_fn = make_tp_train_step(model, tx, mesh, state, donate=False)
+
+    # Oracle: identical math, single device, no sharding.
+    params = model.init(rng, jnp.zeros((batch, min(seq, 128)), jnp.int32),
+                        positions=jnp.arange(min(seq, 128)))["params"]
+    ref = TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                     opt_state=tx.init(params), batch_stats={})
+
+    @jax.jit
+    def ref_step(state, tokens):
+        def loss_fn(params):
+            logits = model.apply({"params": params}, tokens)
+            per = optax.softmax_cross_entropy_with_integer_labels(
+                logits[:, :-1], tokens[:, 1:])
+            return per.mean()
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        return state.replace(
+            step=state.step + 1,
+            params=optax.apply_updates(state.params, updates),
+            opt_state=new_opt), loss
+
+    tok_rng = np.random.default_rng(3)
+    for i in range(3):
+        tokens = jnp.asarray(
+            tok_rng.integers(0, 64, (batch, seq)).astype(np.int32))
+        state, m = step_fn(state, tokens)
+        ref, ref_loss = ref_step(ref, tokens)
+        np.testing.assert_allclose(float(m["loss"]), float(ref_loss),
+                                   rtol=2e-5, atol=2e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5),
+        jax.device_get(state.params), jax.device_get(ref.params))
+
+
+def test_tp_rejects_ring_attention():
+    mesh = make_mesh(data=1, model=8)
+    model = _model(attention_impl="ring")
+    tx = sgd(lr=0.1)
+    with pytest.raises(ValueError, match="ring"):
+        make_tp_train_step(model, tx, mesh, None)

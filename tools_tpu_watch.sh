@@ -1,0 +1,21 @@
+#!/bin/bash
+# Tunnel watcher: probe the axon TPU with a real (tiny) computation every
+# minute; the first window where it answers, fire tools_tpu_batch.sh once.
+# A health probe must be a compiled op, not just jax.devices() — init can
+# succeed while compile hangs (observed 2026-07-30).
+cd /root/repo || exit 1
+export JAX_COMPILATION_CACHE_DIR=/root/repo/.jax_cache
+for i in $(seq 1 "${1:-120}"); do
+  if timeout 90 python -c "
+import jax, jax.numpy as jnp
+assert jax.devices()[0].platform == 'tpu'
+x = jnp.ones((256, 256)); (x @ x).block_until_ready()
+" 2>/dev/null; then
+    echo "tunnel up (probe $i) $(date -u +%H:%M:%S)"
+    bash tools_tpu_batch.sh
+    exit $?
+  fi
+  sleep 55
+done
+echo TUNNEL_NEVER_ANSWERED
+exit 9
