@@ -96,8 +96,11 @@ class LMTrainer:
             raise ValueError(
                 f"could not restore step {step} from {self.cfg.train_dir} "
                 f"into the LM state (a train.py checkpoint in the same "
-                f"train_dir? use a separate --train-dir or --no-resume): "
-                f"{type(e).__name__}: {e}") from e
+                f"train_dir? use a separate --train-dir or --no-resume; "
+                f"checkpoints written before the q/k/v projection split "
+                f"— Block params Dense_0..3 with a packed [d,3d] qkv "
+                f"kernel — predate the current tree and are not "
+                f"restorable): {type(e).__name__}: {e}") from e
         # A CNN checkpoint in the same train_dir would fail deep inside
         # deserialization; check the saved config's model geometry first
         # and fail with an actionable message instead.
