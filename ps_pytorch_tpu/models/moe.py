@@ -1,0 +1,212 @@
+"""Mixture-of-Experts transformer LM (switch-style top-1 routing).
+
+Beyond-parity model family backing expert parallelism (``parallel/ep.py``;
+the reference has no MoE or EP anywhere, SURVEY §2.5). Design points:
+
+- **Top-1 (switch) routing** with a per-expert capacity: each token goes to
+  its argmax expert; tokens beyond ``capacity = ceil(tokens/expert *
+  capacity_factor)`` are dropped (their MLP output is zero — the residual
+  stream carries them unchanged). Gradients flow through the gate
+  probability (argmax itself is non-differentiable), the standard switch
+  estimator.
+- **Per-group dispatch** (``n_groups``): capacity accounting runs
+  independently per contiguous token group. Under expert parallelism each
+  device is one group, so the unsharded oracle with ``n_groups = n_devices``
+  is BIT-IDENTICAL to the sharded run — equivalence is testable exactly
+  (tests/test_ep.py), not just statistically.
+- **Stacked expert parameters** ``experts_w1/b1/w2/b2`` with a leading
+  [n_experts] axis: under EP this axis shards over the mesh; the module
+  works on the local slice inside shard_map (``ep_axis`` bound) and on the
+  full stack outside it.
+- **Load-balance auxiliary loss** (switch eq. 4: E * mean_e(frac_tokens_e *
+  mean_prob_e)) returned alongside the output; the LM sums it over layers
+  and the train step adds ``aux_coef`` times it to the CE loss.
+
+The dense (non-MoE) parts mirror ``models/transformer.py``'s Block exactly
+(same attention path, LayerNorm/Dense layout), so MoE slots into the same
+runtime contracts.
+"""
+
+import math
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ps_pytorch_tpu.parallel.ring import full_attention
+
+
+class MoEMLP(nn.Module):
+    """Switch MLP: route each token to 1 of ``n_experts`` expert FFNs."""
+    n_experts: int
+    d_model: int
+    d_hidden: int
+    capacity_factor: float = 1.25
+    n_groups: int = 1                 # capacity accounting granularity
+    ep_axis: Optional[str] = None     # set inside shard_map for EP
+    # Under EP each device stores n_experts / n_devices experts; flax
+    # validates stored param shapes against their declaration, so the
+    # declaration must say the LOCAL count (parallel/ep.py sets this).
+    n_local_experts: Optional[int] = None
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        # x: [B, S, D] (the local shard when under shard_map)
+        b, s, d = x.shape
+        e = self.n_experts
+        tokens = x.reshape(-1, d)                     # [T, D]
+        t = tokens.shape[0]
+        if self.ep_axis is not None and self.n_groups != 1:
+            raise ValueError("under expert parallelism each device is one "
+                             "dispatch group: use n_groups=1")
+        if t % self.n_groups:
+            raise ValueError(f"{t} tokens not divisible into "
+                             f"{self.n_groups} groups")
+        if d != self.d_model:
+            raise ValueError(f"input feature dim {d} != d_model "
+                             f"{self.d_model}")
+        g = self.n_groups
+        tg = t // g
+        cap = max(math.ceil(tg / e * self.capacity_factor), 1)
+
+        router = nn.Dense(e, use_bias=False, dtype=self.dtype,
+                          name="router")(tokens)      # [T, E]
+        probs = jax.nn.softmax(router.astype(jnp.float32), axis=-1)
+        gate = jnp.max(probs, axis=-1)                # [T]
+        expert_idx = jnp.argmax(probs, axis=-1)       # [T]
+
+        # Per-group dispatch: position of each token in its expert's queue,
+        # counted within the group; tokens past capacity are dropped.
+        onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)  # [T, E]
+        oh_g = onehot.reshape(g, tg, e)
+        pos = jnp.cumsum(oh_g, axis=1) - oh_g         # [G, TG, E]
+        pos = jnp.sum(pos * oh_g, axis=-1)            # [G, TG] queue pos
+        keep = (pos < cap).reshape(t)                 # [T]
+        slot = jax.nn.one_hot(pos.reshape(t).astype(jnp.int32), cap,
+                              dtype=jnp.float32)
+        # dispatch [G, TG, E, C]: one-hot of (expert, slot) for kept tokens
+        disp = (onehot * keep[:, None])[:, :, None] * slot[:, None, :]
+        disp = disp.reshape(g, tg, e, cap)
+        xg = tokens.reshape(g, tg, d)
+        expert_in = jnp.einsum("gtec,gtd->gecd", disp, xg)  # [G, E, C, D]
+
+        # Stacked expert FFNs. Under EP the leading axis is the LOCAL
+        # expert slice; all_to_all swaps the grouping from
+        # (all experts, my tokens) to (my experts, all groups' tokens).
+        el = self.n_local_experts if self.n_local_experts is not None else e
+        w1 = self.param("experts_w1", nn.initializers.lecun_normal(),
+                        (el, d, self.d_hidden))
+        b1 = self.param("experts_b1", nn.initializers.zeros,
+                        (el, self.d_hidden))
+        w2 = self.param("experts_w2", nn.initializers.lecun_normal(),
+                        (el, self.d_hidden, d))
+        b2 = self.param("experts_b2", nn.initializers.zeros, (el, d))
+
+        def ffn(xin, w1, b1, w2, b2):
+            h = jnp.einsum("ecd,edh->ech", xin.astype(self.dtype),
+                           w1.astype(self.dtype)) + b1[:, None].astype(
+                               self.dtype)
+            return jnp.einsum("ech,ehd->ecd", nn.gelu(h),
+                              w2.astype(self.dtype)) + b2[:, None].astype(
+                                  self.dtype)
+
+        if self.ep_axis is not None:
+            # Inside shard_map: this device is ONE group (g == 1) and holds
+            # el = e / n experts.
+            n = jax.lax.axis_size(self.ep_axis)
+            if el * n != e:
+                raise ValueError(f"n_local_experts={el} x {n} devices != "
+                                 f"{e} experts")
+            ein = expert_in[0]                        # [E, C, D]
+            ein = jax.lax.all_to_all(ein, self.ep_axis, split_axis=0,
+                                     concat_axis=1, tiled=True)
+            out = ffn(ein, w1, b1, w2, b2)            # [E/n, n*C, D]
+            out = jax.lax.all_to_all(out, self.ep_axis, split_axis=1,
+                                     concat_axis=0, tiled=True)
+            expert_out = out[None]                    # [1, E, C, D]
+        else:
+            expert_out = jax.vmap(ffn, in_axes=(0, None, None, None, None))(
+                expert_in, w1, b1, w2, b2)            # [G, E, C, D]
+
+        combine = disp * gate.reshape(g, tg)[:, :, None, None]
+        y = jnp.einsum("gtec,gecd->gtd", combine,
+                       expert_out.astype(jnp.float32))
+        y = y.reshape(b, s, d).astype(x.dtype)
+
+        # Switch load-balance loss, per group then averaged: pushes the
+        # router toward uniform expert usage.
+        frac_tokens = jnp.mean(oh_g, axis=1)          # [G, E]
+        frac_probs = jnp.mean(probs.reshape(g, tg, e), axis=1)
+        aux = e * jnp.mean(jnp.sum(frac_tokens * frac_probs, axis=-1))
+        return y, aux
+
+
+class MoEBlock(nn.Module):
+    """transformer.Block with the dense MLP swapped for MoEMLP."""
+    n_heads: int
+    d_model: int
+    n_experts: int
+    capacity_factor: float = 1.25
+    n_groups: int = 1
+    ep_axis: Optional[str] = None
+    n_local_experts: Optional[int] = None
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        b, s, d = x.shape
+        h = self.n_heads
+        hd = d // h
+        y = nn.LayerNorm(dtype=self.dtype)(x)
+        q = nn.Dense(d, use_bias=False, dtype=self.dtype)(y)
+        k = nn.Dense(d, use_bias=False, dtype=self.dtype)(y)
+        v = nn.Dense(d, use_bias=False, dtype=self.dtype)(y)
+        to_heads = lambda t: t.reshape(b, s, h, hd).transpose(0, 2, 1, 3)
+        o = full_attention(to_heads(q), to_heads(k), to_heads(v), causal=True)
+        o = o.transpose(0, 2, 1, 3).reshape(b, s, d)
+        x = x + nn.Dense(d, use_bias=False, dtype=self.dtype)(o)
+        y = nn.LayerNorm(dtype=self.dtype)(x)
+        m, aux = MoEMLP(self.n_experts, self.d_model, 4 * self.d_model,
+                        self.capacity_factor, self.n_groups, self.ep_axis,
+                        self.n_local_experts, self.dtype, name="moe")(y)
+        return x + m, aux
+
+
+class MoETransformerLM(nn.Module):
+    """Decoder-only LM with an MoE MLP in every block.
+
+    Returns (logits [B, S, V] float32, aux scalar = summed load-balance
+    losses)."""
+    vocab_size: int = 256
+    n_layers: int = 2
+    n_heads: int = 4
+    d_model: int = 128
+    n_experts: int = 8
+    capacity_factor: float = 1.25
+    n_groups: int = 1
+    max_seq_len: int = 2048
+    ep_axis: Optional[str] = None
+    n_local_experts: Optional[int] = None
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, tokens, positions: Optional[jax.Array] = None):
+        if positions is None:
+            positions = jnp.arange(tokens.shape[1])
+        x = nn.Embed(self.vocab_size, self.d_model, dtype=self.dtype,
+                     name="tok_embed")(tokens)
+        x = x + nn.Embed(self.max_seq_len, self.d_model, dtype=self.dtype,
+                         name="pos_embed")(positions)[None]
+        aux_total = jnp.float32(0.0)
+        for i in range(self.n_layers):
+            x, aux = MoEBlock(self.n_heads, self.d_model, self.n_experts,
+                              self.capacity_factor, self.n_groups,
+                              self.ep_axis, self.n_local_experts,
+                              self.dtype, name=f"block_{i}")(x)
+            aux_total = aux_total + aux
+        x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
+        logits = nn.Dense(self.vocab_size, use_bias=False, dtype=self.dtype,
+                          name="lm_head")(x)
+        return logits.astype(jnp.float32), aux_total

@@ -1,0 +1,146 @@
+"""Expert parallelism (EP) — MoE experts sharded over the mesh.
+
+Beyond-parity (the reference has no MoE/EP, SURVEY §2.5; with dp/tp/pp/sp/
+zero this closes the full DP/TP/PP/SP/EP/ZeRO inventory): the stacked
+expert FFNs of ``models/moe.MoETransformerLM`` shard their leading
+[n_experts] axis over the mesh's 'data' axis — the DeepSpeed-MoE layout
+where the EP group IS the DP group: every device holds its batch shard AND
+n_experts/n experts. Token routing crosses devices with one pair of
+``all_to_all`` collectives per MoE layer (dispatch slots out, expert
+outputs back), executed INSIDE the layer when ``ep_axis`` is bound — the
+same inside-the-module collective pattern as ring attention.
+
+Gradient structure mirrors ``parallel/pp.py``: the loss is a LOCAL sum
+(never psum inside the differentiated function — the double-count pitfall),
+expert-parameter grads are complete per-device via the all_to_all
+transpose (every token that visited the expert contributes, wherever it
+came from), and replicated params (router, attention, embeddings) need one
+psum over 'data'.
+
+Exactness: dispatch capacity is accounted per device; the unsharded oracle
+with ``n_groups = n_devices`` computes the identical math, so
+sharded-vs-unsharded equivalence is exact (tests/test_ep.py), not
+statistical.
+"""
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.tree_util import keystr, tree_flatten_with_path
+
+from ps_pytorch_tpu.parallel.dp import TrainState
+from ps_pytorch_tpu.parallel.tp import _opt_state_specs
+
+_EXPERT_KEY = "experts_"   # models/moe.py stacked expert param names
+
+
+def ep_param_specs(params, axis: str = "data"):
+    """Stacked expert leaves shard over ``axis``; everything else
+    replicates."""
+    paths, treedef = tree_flatten_with_path(params)
+    return jax.tree_util.tree_unflatten(
+        treedef, [P(axis) if _EXPERT_KEY in keystr(p) else P()
+                  for p, _ in paths])
+
+
+def ep_state_specs(state_shapes: TrainState, axis: str = "data") -> TrainState:
+    pspecs = ep_param_specs(state_shapes.params, axis)
+    return TrainState(
+        step=P(),
+        params=pspecs,
+        opt_state=_opt_state_specs(state_shapes.opt_state,
+                                   state_shapes.params, pspecs),
+        batch_stats={},
+    )
+
+
+def create_ep_train_state(model, tx: optax.GradientTransformation,
+                          mesh: Mesh, sample_tokens,
+                          rng: Optional[jax.Array] = None,
+                          axis: str = "data") -> TrainState:
+    """Init the MoE LM with expert-sharded placement. ``model`` should be
+    the ORACLE form (ep_axis=None) — the parameter tree is identical."""
+    if rng is None:
+        rng = jax.random.key(0)
+    init_model = model.clone(ep_axis=None, n_groups=1,
+                             n_local_experts=None)
+    init_len = min(sample_tokens[1], 128)
+
+    def init_fn(rng):
+        variables = init_model.init(
+            rng, jnp.zeros((sample_tokens[0], init_len), jnp.int32),
+            positions=jnp.arange(init_len))
+        params = variables["params"]
+        return TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                          opt_state=tx.init(params), batch_stats={})
+
+    shapes = jax.eval_shape(init_fn, rng)
+    specs = ep_state_specs(shapes, axis)
+    shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                             is_leaf=lambda x: isinstance(x, P))
+    with mesh:
+        return jax.jit(init_fn, out_shardings=shardings)(rng)
+
+
+def make_ep_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
+                       state: TrainState, *, axis: str = "data",
+                       aux_coef: float = 0.01,
+                       donate: bool = True) -> Callable:
+    """-> step_fn(state, tokens) -> (state, {'loss', 'aux'}).
+
+    tokens [B, S] int32, batch sharded over ``axis``. ``model`` must be
+    built with ``ep_axis=axis`` and ``n_groups=1`` (each device dispatches
+    its own tokens); n_experts must divide by the axis size.
+    """
+    if getattr(model, "ep_axis", None) != axis:
+        raise ValueError(f"model.ep_axis={model.ep_axis!r} != step axis "
+                         f"{axis!r} — build the model with ep_axis={axis!r}")
+    n = mesh.shape[axis]
+    if model.n_experts % n:
+        raise ValueError(f"{model.n_experts} experts not divisible over "
+                         f"{n} devices")
+    # flax validates stored param shapes against their declaration; inside
+    # shard_map each device holds the local expert slice, so the module
+    # must declare the local count.
+    model = model.clone(n_local_experts=model.n_experts // n, n_groups=1)
+
+    def local_step(state, tokens):
+        def loss_fn(params):
+            logits, aux = model.apply({"params": params}, tokens)
+            per = optax.softmax_cross_entropy_with_integer_labels(
+                logits[:, :-1], tokens[:, 1:])
+            # LOCAL sums; collectives on the grads, not in the loss.
+            return per.sum() + aux_coef * aux * per.size, \
+                (jnp.float32(per.size), per.sum(), aux)
+
+        (_, (count, ce_sum, aux)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state.params)
+        total = jax.lax.psum(count, axis)
+
+        def reduce_grad(path, g):
+            # Expert leaves are device-owned: the all_to_all transpose
+            # already delivered every visiting token's contribution.
+            if _EXPERT_KEY in keystr(path):
+                return g / total
+            return jax.lax.psum(g, axis) / total
+
+        paths, treedef = tree_flatten_with_path(grads)
+        grads = jax.tree_util.tree_unflatten(
+            treedef, [reduce_grad(p, g) for p, g in paths])
+        loss = jax.lax.psum(ce_sum, axis) / total
+        aux = jax.lax.pmean(aux, axis)
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        return state.replace(step=state.step + 1, params=new_params,
+                             opt_state=new_opt), {"loss": loss, "aux": aux}
+
+    specs = ep_state_specs(jax.eval_shape(lambda s: s, state), axis)
+    sharded = jax.shard_map(
+        local_step, mesh=mesh,
+        in_specs=(specs, P(axis, None)),
+        out_specs=(specs, {"loss": P(), "aux": P()}),
+        check_vma=False)
+    return jax.jit(sharded, donate_argnums=(0,) if donate else ())

@@ -132,10 +132,10 @@ def reference_forward(model, params, tokens):
 
 def pp_state_specs(state_shapes: TrainState) -> TrainState:
     """Stacked block leaves (and their optimizer mirrors) shard over
-    'model'; everything else replicates. Matched structurally: any leaf
-    whose leading dim equals the stage count of the block stack is a stage
-    stack — the edge params (vocab/seq tables) never alias it because specs
-    are derived per-path below."""
+    'model'; everything else replicates. Matching is BY KEY: exactly the
+    top-level ``'blocks'`` entry (what ``stack_stage_params`` produces) is
+    stage-sharded — a new stacked param group under another key would need
+    its own rule here."""
     def param_specs(tree):
         return {k: (jax.tree.map(lambda _: P("model"), v) if k == "blocks"
                     else jax.tree.map(lambda _: P(), v))
@@ -189,7 +189,6 @@ def make_pp_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
     if getattr(model, "attention_impl", "full") != "full":
         raise ValueError("PP step requires attention_impl='full'")
     n_stages = mesh.shape[axis_name]
-    n_data = mesh.shape[data_axis]
     M = num_microbatches
     stacked = jax.tree.leaves(state.params["blocks"])[0].shape[0]
     if stacked != n_stages:
