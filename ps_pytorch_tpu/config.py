@@ -87,6 +87,10 @@ class TrainConfig:
     lm_heads: int = 4
     lm_seq_len: int = 1024           # sharded over the mesh (ring attention)
     lm_corpus_tokens: int = 1_000_000
+    lm_parallelism: str = "sp"       # sp (sequence/ring) | tp (tensor) | pp (pipeline) | ep (MoE experts)
+    lm_model_axis: int = 0           # tp/pp: size of the 'model' mesh axis (0 = all devices)
+    lm_microbatches: int = 4         # pp: GPipe microbatch count
+    lm_experts: int = 8              # ep: expert count (divisible by device count)
 
     # -- fault injection (tests / straggler drills; SURVEY §5.3: the
     #    reference had none) --
@@ -110,6 +114,9 @@ class TrainConfig:
         if self.lr_schedule not in ("constant", "step", "cosine"):
             raise ValueError(f"unknown lr_schedule {self.lr_schedule!r} "
                              "(constant | step | cosine)")
+        if self.lm_parallelism not in ("sp", "tp", "pp", "ep"):
+            raise ValueError(f"unknown lm_parallelism "
+                             f"{self.lm_parallelism!r} (sp | tp | pp | ep)")
         if self.grad_codec not in ("blosc", "int8"):
             raise ValueError(f"unknown grad_codec {self.grad_codec!r} (blosc | int8)")
         if self.nesterov and (self.momentum <= 0):

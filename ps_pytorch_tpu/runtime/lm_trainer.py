@@ -1,11 +1,18 @@
 """LM trainer — long-context training through the standard runtime contract.
 
-Drives ``models/transformer.TransformerLM`` with the sequence-parallel step
-(``parallel/sp.py``: sequence sharded over the mesh, ring attention when
-more than one device is present) while reusing the framework's standard
-machinery: TrainConfig, MetricsLogger STEP schema, atomic checkpoints with
-resume, and the evaluator's held-out oracle (here: next-token loss /
-perplexity on a disjoint tail of the stream).
+Drives a transformer LM under the parallelism selected by
+``--lm-parallelism`` while reusing the framework's standard machinery
+(TrainConfig, MetricsLogger STEP schema, atomic checkpoints with resume,
+held-out next-token-loss oracle):
+
+- ``sp`` (default): sequence sharded over the mesh, ring attention
+  (``parallel/sp.py``) — the long-context mode.
+- ``tp``: Megatron-style tensor parallelism over the 'model' axis,
+  composed with DP over 'data' (``parallel/tp.py``).
+- ``pp``: GPipe pipeline over the 'model' axis with ``--lm-microbatches``
+  (``parallel/pp.py``).
+- ``ep``: switch-MoE model with experts sharded over 'data'
+  (``models/moe.py`` + ``parallel/ep.py``).
 
 The reference has no LM surface at all — this is the §5.7 long-context
 capability expressed as a first-class entry point (``train_lm.py``), not
@@ -38,27 +45,86 @@ class LMTrainer:
     def __init__(self, cfg: TrainConfig):
         self.cfg = cfg
         devices = jax.devices()
-        self.mesh = Mesh(np.array(devices), ("data",))
-        impl = "ring" if len(devices) > 1 else "full"
-        if cfg.lm_seq_len % len(devices):
-            raise ValueError(f"lm_seq_len {cfg.lm_seq_len} not divisible by "
-                             f"{len(devices)} devices (sequence sharding)")
-        self.model = TransformerLM(
-            vocab_size=cfg.lm_vocab, d_model=cfg.lm_d_model,
-            n_layers=cfg.lm_layers, n_heads=cfg.lm_heads,
-            max_seq_len=cfg.lm_seq_len, attention_impl=impl,
-            axis_name="data")
+        n = len(devices)
         # The SP step consumes an optax transform (tx.update); the fused
         # Pallas optimizers (apply-style) are a CNN-step dispatch — use the
         # plain golden-tested transform here regardless of the flag.
         self.tx = sgd(lr=build_schedule(cfg), momentum=cfg.momentum,
                       weight_decay=cfg.weight_decay, nesterov=cfg.nesterov)
-        self.state = create_lm_train_state(
-            self.model, self.tx, self.mesh,
-            (cfg.batch_size, cfg.lm_seq_len), jax.random.key(cfg.seed))
-        self.step_fn = make_sp_train_step(self.model, self.tx, self.mesh,
-                                          donate=cfg.donate)
-        self.eval_fn = make_sp_eval_fn(self.model, self.mesh)
+        self.mode = cfg.lm_parallelism
+        key = jax.random.key(cfg.seed)
+        lm_kw = dict(vocab_size=cfg.lm_vocab, d_model=cfg.lm_d_model,
+                     n_layers=cfg.lm_layers, n_heads=cfg.lm_heads,
+                     max_seq_len=cfg.lm_seq_len)
+
+        if self.mode == "sp":
+            # Sequence sharded over 'data', ring attention across shards.
+            self.mesh = Mesh(np.array(devices), ("data",))
+            impl = "ring" if n > 1 else "full"
+            if cfg.lm_seq_len % n:
+                raise ValueError(f"lm_seq_len {cfg.lm_seq_len} not "
+                                 f"divisible by {n} devices (sequence "
+                                 f"sharding)")
+            self.model = TransformerLM(attention_impl=impl,
+                                       axis_name="data", **lm_kw)
+            self.state = create_lm_train_state(
+                self.model, self.tx, self.mesh,
+                (cfg.batch_size, cfg.lm_seq_len), key)
+            self.step_fn = make_sp_train_step(self.model, self.tx,
+                                              self.mesh, donate=cfg.donate)
+            self.eval_fn = make_sp_eval_fn(self.model, self.mesh)
+        elif self.mode in ("tp", "pp"):
+            from ps_pytorch_tpu.parallel.mesh import make_mesh
+            deg = cfg.lm_model_axis or n
+            if n % deg:
+                raise ValueError(f"{n} devices not divisible by "
+                                 f"lm_model_axis={deg}")
+            self.mesh = make_mesh(data=n // deg, model=deg,
+                                  devices=devices)
+            self.model = TransformerLM(**lm_kw)
+            if self.mode == "tp":
+                from ps_pytorch_tpu.parallel.tp import (
+                    create_tp_train_state, make_tp_train_step,
+                )
+                self.state = create_tp_train_state(
+                    self.model, self.tx, self.mesh,
+                    (cfg.batch_size, cfg.lm_seq_len), key)
+                self.step_fn = make_tp_train_step(
+                    self.model, self.tx, self.mesh, self.state,
+                    donate=cfg.donate)
+            else:
+                from ps_pytorch_tpu.parallel.pp import (
+                    create_pp_train_state, make_pp_train_step,
+                )
+                if cfg.lm_layers % deg:
+                    raise ValueError(f"lm_layers={cfg.lm_layers} not "
+                                     f"divisible into {deg} stages")
+                self.state = create_pp_train_state(
+                    self.model, self.tx, self.mesh, deg,
+                    (cfg.batch_size, cfg.lm_seq_len), key)
+                self.step_fn = make_pp_train_step(
+                    self.model, self.tx, self.mesh, self.state,
+                    num_microbatches=cfg.lm_microbatches,
+                    donate=cfg.donate)
+            self.eval_fn = None   # oracle eval (see evaluate())
+        elif self.mode == "ep":
+            from ps_pytorch_tpu.models.moe import MoETransformerLM
+            from ps_pytorch_tpu.parallel.ep import (
+                create_ep_train_state, make_ep_train_step,
+            )
+            from ps_pytorch_tpu.parallel.mesh import make_mesh
+            self.mesh = make_mesh(data=n, model=1, devices=devices)
+            self.model = MoETransformerLM(n_experts=cfg.lm_experts,
+                                          ep_axis="data", **lm_kw)
+            self.state = create_ep_train_state(
+                self.model, self.tx, self.mesh,
+                (cfg.batch_size, cfg.lm_seq_len), key)
+            self.step_fn = make_ep_train_step(
+                self.model, self.tx, self.mesh, self.state,
+                donate=cfg.donate)
+            self.eval_fn = None
+        else:  # unreachable: TrainConfig.__post_init__ validates
+            raise ValueError(self.mode)
 
         stream = synthetic_tokens(cfg.lm_corpus_tokens, cfg.lm_vocab,
                                   seed=cfg.seed)
@@ -73,12 +139,15 @@ class LMTrainer:
 
     # ---- checkpoint/resume (same on-disk contract as the CNN Trainer) ----
     def _checkpoint(self, step: int) -> None:
-        # Checkpoint authority stays with the leader (trainer.py does the
-        # same): concurrent writers to a shared train_dir would race.
+        # The gather is COLLECTIVE (tp/pp/ep shard params over devices that
+        # can span hosts, and process_allgather needs every host), so it
+        # runs on all processes; only the leader writes — concurrent
+        # writers to a shared train_dir would race (trainer.py does the
+        # same).
+        host_state = dist.all_replicated(self.mesh, self.state)
         if jax.process_index() != 0:
             return
-        ckpt.save_checkpoint(self.cfg.train_dir, step,
-                             jax.device_get(self.state),
+        ckpt.save_checkpoint(self.cfg.train_dir, step, host_state,
                              config_json=self.cfg.to_json(),
                              compress=self.cfg.compress_grad,
                              codec_level=self.cfg.codec_level)
@@ -108,7 +177,11 @@ class LMTrainer:
             saved = json.loads(config_json)
         except (TypeError, ValueError):
             saved = {}
-        for k in ("lm_vocab", "lm_d_model", "lm_layers", "lm_heads"):
+        # lm_model_axis matters for pp: blocks are stacked per stage, and a
+        # different stage count would restore without shape validation and
+        # silently drop layers inside the step's per-stage slicing.
+        for k in ("lm_vocab", "lm_d_model", "lm_layers", "lm_heads",
+                  "lm_parallelism", "lm_experts", "lm_model_axis"):
             if k in saved and saved[k] != getattr(self.cfg, k):
                 raise ValueError(
                     f"checkpoint in {self.cfg.train_dir} was written with "
@@ -131,11 +204,12 @@ class LMTrainer:
             tokens = self.train_loader.next_batch()
             t_data = time.monotonic() - t0
             # Every process generates the identical shared-seed batch; the
-            # globalize places each host's sequence shard (multi-process
-            # safe — a host-local committed array can't feed a multi-host
-            # shard_map).
+            # globalize places each host's shard (multi-process safe — a
+            # host-local committed array can't feed a multi-host
+            # shard_map). SP shards the SEQUENCE axis; tp/pp/ep shard the
+            # batch axis.
             tok_g = dist.globalize_replicated(self.mesh, tokens,
-                                              spec=P(None, "data"))
+                                              spec=self._token_spec())
             self.state, m = self.step_fn(self.state, tok_g)
             if step % cfg.log_every == 0 or step == cfg.max_steps:
                 loss = float(m["loss"])
@@ -151,21 +225,64 @@ class LMTrainer:
         self.metrics.close()
         return self.state
 
+    def _token_spec(self) -> P:
+        return P(None, "data") if self.mode == "sp" else P("data", None)
+
+    def _oracle_eval_fn(self):
+        """Grad-free eval for tp/pp/ep: gather params to their logical tree
+        and run the plain (unsharded) model — fine at checkpoint cadence.
+        SP keeps its sharded ring eval (a full-attention clone at the global
+        sequence length is exactly the OOM that mode exists to avoid)."""
+        import optax
+        if self.mode == "pp":
+            from ps_pytorch_tpu.parallel.pp import unstack_stage_params
+            to_tree = unstack_stage_params
+            model = self.model
+            apply = lambda p, t: model.apply({"params": p}, t)
+        elif self.mode == "ep":
+            # n_groups = data-axis size keeps the oracle's per-group
+            # capacity accounting identical to the sharded forward (the
+            # exactness models/moe.py is designed around); n_groups=1
+            # would capacity-drop a DIFFERENT token set than training.
+            oracle = self.model.clone(ep_axis=None,
+                                      n_groups=self.mesh.shape["data"],
+                                      n_local_experts=None)
+            to_tree = lambda p: p
+            apply = lambda p, t: oracle.apply({"params": p}, t)[0]
+        else:  # tp — sharded but logically the plain tree
+            model = self.model
+            to_tree = lambda p: p
+            apply = lambda p, t: model.apply({"params": p}, t)
+
+        @jax.jit
+        def loss_fn(params, tokens):
+            logits = apply(params, tokens)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits[:, :-1], tokens[:, 1:]).mean()
+
+        # all_replicated, not device_get: tp/pp/ep leaves are sharded over
+        # devices that can span hosts.
+        params = to_tree(dist.all_replicated(self.mesh, self.state.params))
+        return lambda tokens: float(loss_fn(params, tokens))
+
     def evaluate(self, max_batches: Optional[int] = None) -> dict:
         """Held-out next-token loss + perplexity (the LM analogue of the
-        evaluator's Prec@1 oracle), through the SAME sharded ring-attention
-        forward as training — a full-attention clone at the global sequence
-        length would materialize the [S, S] scores on one device, the OOM
-        the long-context design exists to avoid."""
+        evaluator's Prec@1 oracle). SP evaluates through the SAME sharded
+        ring-attention forward as training; tp/pp/ep evaluate via the
+        unsharded oracle forward on gathered params."""
         cfg = self.cfg
         val = TokenLoader(self.val_tokens, cfg.batch_size, cfg.lm_seq_len,
                           seed=0, shuffle=False)
+        oracle = None if self.mode == "sp" else self._oracle_eval_fn()
         losses = []
         for i, tokens in enumerate(val.epoch(0)):
             if max_batches is not None and i >= max_batches:
                 break
+            if oracle is not None:
+                losses.append(oracle(jnp.asarray(tokens)))
+                continue
             tok_g = dist.globalize_replicated(self.mesh, tokens,
-                                              spec=P(None, "data"))
+                                              spec=self._token_spec())
             losses.append(float(self.eval_fn(self.state.params, tok_g)))
         loss = float(np.mean(losses)) if losses else float("nan")
         return {"loss": loss, "perplexity": float(np.exp(min(loss, 30.0))),

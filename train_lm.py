@@ -2,11 +2,15 @@
 """LM training entry point — long context through the standard contract.
 
 Same config/checkpoint/metrics machinery as ``train.py``, driving the
-sequence-parallel transformer step (ring attention across the mesh when
-more than one device is present; the sequence axis is the sharded axis).
+transformer LM under the selected parallelism: sequence-parallel ring
+attention (default), tensor parallelism, GPipe pipeline, or MoE expert
+parallelism.
 
     python train_lm.py --lm-seq-len 4096 --batch-size 8 --lr 0.3 \
         --momentum 0.9 --max-steps 200 --eval-freq 100
+    python train_lm.py --lm-parallelism tp --lm-model-axis 4 ...
+    python train_lm.py --lm-parallelism pp --lm-layers 8 --lm-microbatches 8 ...
+    python train_lm.py --lm-parallelism ep --lm-experts 16 ...
 """
 
 import sys
@@ -24,7 +28,8 @@ def main(argv=None) -> int:
     print(f"CONFIG {cfg.to_json()}")
     trainer = LMTrainer(cfg)
     print(f"LM mesh devices={len(trainer.mesh.devices.flat)} "
-          f"attention={trainer.model.attention_impl} "
+          f"parallelism={cfg.lm_parallelism} "
+          f"attention={getattr(trainer.model, 'attention_impl', 'full')} "
           f"seq_len={cfg.lm_seq_len}")
     trainer.train()
     result = trainer.evaluate(max_batches=8)
