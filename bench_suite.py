@@ -292,6 +292,61 @@ def bench_transformer_lm(name, steps, *, batch=8, seq_len=2048, d_model=512,
             "loss": round(float(m["loss"]), 4)}
 
 
+def bench_moe_lm(name, steps, *, batch=8, seq_len=2048, d_model=512,
+                 n_layers=8, n_heads=8, vocab=32000, n_experts=8):
+    """MoE (switch top-1) LM throughput next to the dense transformer row:
+    same geometry with every block's MLP replaced by n_experts experts —
+    ~n_experts x the MLP parameters at (ideally) dense-like step time. The
+    gap between this row's tokens/sec and transformer_lm_2k's is the
+    routing overhead (dispatch/combine einsums + capacity accounting;
+    all_to_all only materializes with >1 device). Experts shard over
+    'data' (parallel/ep.py)."""
+    import jax
+    from ps_pytorch_tpu.config import TrainConfig
+    from ps_pytorch_tpu.models.moe import MoETransformerLM
+    from ps_pytorch_tpu.optim import build_optimizer
+    from ps_pytorch_tpu.parallel.ep import (
+        create_ep_train_state, make_ep_train_step,
+    )
+    from ps_pytorch_tpu.parallel.mesh import make_mesh
+
+    devices = jax.devices()
+    n = len(devices)
+    mesh = make_mesh(data=n, model=1, devices=devices)
+    # Round UP to a multiple of the device count: ep requires
+    # n_experts % n_devices == 0 (max(n_experts, n) breaks on e.g. 6
+    # devices).
+    e = -(-n_experts // n) * n
+    model = MoETransformerLM(vocab_size=vocab, d_model=d_model,
+                             n_layers=n_layers, n_heads=n_heads,
+                             n_experts=e, max_seq_len=seq_len,
+                             ep_axis="data")
+    cfg = TrainConfig(dataset="synthetic", network="LeNet", batch_size=batch,
+                      lr=0.01, momentum=0.9)
+    tx = build_optimizer(cfg)
+    state = create_ep_train_state(model, tx, mesh, (batch, seq_len))
+    step_fn = make_ep_train_step(model, tx, mesh, state)
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, vocab, size=(batch, seq_len)),
+                         jnp.int32)
+    for _ in range(3):
+        state, m = step_fn(state, tokens)
+    jax.block_until_ready(state.params)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, m = step_fn(state, tokens)
+    jax.block_until_ready(state.params)
+    dt = (time.perf_counter() - t0) / steps
+    return {"config": name,
+            "platform": jax.devices()[0].platform, "devices": n,
+            "batch": batch, "seq_len": seq_len, "d_model": d_model,
+            "n_layers": n_layers, "n_experts": e,
+            "sec_per_step": round(dt, 5),
+            "tokens_per_sec": round(batch * seq_len / dt, 1),
+            "loss": round(float(m["loss"]), 4),
+            "aux": round(float(m["aux"]), 4)}
+
+
 def bench_time_to_loss(name, network, dataset, batch, target_loss,
                        max_steps=400):
     """Convergence probe: wall-clock to reach target training loss on a
@@ -365,6 +420,7 @@ CONFIGS = {
         "resnet18_async_2slice", steps),
     "transformer_lm_2k": lambda steps: bench_transformer_lm(
         "transformer_lm_2k", steps),
+    "moe_lm_2k": lambda steps: bench_moe_lm("moe_lm_2k", steps),
     "lenet_convergence": lambda steps: bench_time_to_loss(
         "lenet_convergence", "LeNet", "synthetic_mnist", 512,
         target_loss=0.8),

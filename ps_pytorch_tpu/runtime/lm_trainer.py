@@ -156,9 +156,13 @@ class LMTrainer:
         step = ckpt.latest_step(self.cfg.train_dir)
         if step is None:
             return False
+        # Collective gather for the restore template, mirroring
+        # _checkpoint: tp/pp/ep shard state across hosts, where a plain
+        # device_get raises on non-addressable shards.
+        template = dist.all_replicated(self.mesh, self.state)
         try:
             state, meta, config_json = ckpt.load_checkpoint(
-                self.cfg.train_dir, step, jax.device_get(self.state))
+                self.cfg.train_dir, step, template)
         except Exception as e:
             # Most likely a non-LM (CNN) checkpoint sharing the default
             # ./train_dir — surface that instead of a msgpack key error.
@@ -188,7 +192,13 @@ class LMTrainer:
                     f"{k}={saved[k]} but this run uses "
                     f"{getattr(self.cfg, k)} — wrong train_dir, or pass "
                     f"--no-resume / a fresh --train-dir")
-        self.state = jax.device_put(state)
+        # Re-place every leaf with the sharding the live state was built
+        # with (stage/expert-sharded for pp/ep, TP-sharded kernels, or
+        # plain replication) — a bare device_put would leave host-local
+        # arrays that cannot feed a multi-host shard_map step.
+        self.state = jax.tree.map(
+            lambda h, live: jax.device_put(h, live.sharding),
+            state, self.state)
         self.start_step = int(meta["step"])
         print(f"RESUME lm at step {self.start_step}")
         return True

@@ -116,6 +116,30 @@ def test_tp_step_matches_unsharded(data, model_ax):
         jax.device_get(state.params), jax.device_get(ref.params))
 
 
+def test_tp_opt_state_specs_adam_two_mirrors():
+    """Adam embeds the param tree twice (mu and nu): every sharded param
+    spec must appear exactly twice among the sharded opt-state specs."""
+    from ps_pytorch_tpu.optim.adam import adam
+
+    model = _model()
+    tx = adam(lr=1e-3)
+
+    def init_fn(rng):
+        params = model.init(rng, jnp.zeros((2, 16), jnp.int32),
+                            positions=jnp.arange(16))["params"]
+        return TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                          opt_state=tx.init(params), batch_stats={})
+
+    shapes = jax.eval_shape(init_fn, jax.random.key(0))
+    specs = tp_state_specs(shapes)
+    sharded_p = [s for s in jax.tree.leaves(
+        specs.params, is_leaf=lambda x: isinstance(x, P)) if s != P()]
+    sharded_o = [s for s in jax.tree.leaves(
+        specs.opt_state, is_leaf=lambda x: isinstance(x, P)) if s != P()]
+    assert len(sharded_o) == 2 * len(sharded_p)
+    assert sorted(map(str, sharded_o)) == sorted(map(str, sharded_p * 2))
+
+
 def test_tp_rejects_ring_attention():
     mesh = make_mesh(data=1, model=8)
     model = _model(attention_impl="ring")
