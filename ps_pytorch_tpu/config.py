@@ -87,6 +87,7 @@ class TrainConfig:
     lm_heads: int = 4
     lm_seq_len: int = 1024           # sharded over the mesh (ring attention)
     lm_corpus_tokens: int = 1_000_000
+    lm_corpus_file: str = ""         # byte-level REAL corpus from any local file ("" = synthetic Markov stream)
     lm_parallelism: str = "sp"       # sp (sequence/ring) | tp (tensor) | pp (pipeline) | ep (MoE experts)
     lm_model_axis: int = 0           # tp/pp: size of the 'model' mesh axis (0 = all devices)
     lm_microbatches: int = 4         # pp: GPipe microbatch count

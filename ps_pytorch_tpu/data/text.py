@@ -34,6 +34,27 @@ def synthetic_tokens(n_tokens: int, vocab: int = 256,
     return out
 
 
+def tokens_from_file(path: str, vocab: int = 256,
+                     max_tokens: int = 0) -> np.ndarray:
+    """Byte-level tokenization of any local file: REAL corpus data with no
+    network and no tokenizer — each byte is a token (so ``vocab`` must be
+    >= 256; a larger vocab just leaves ids 256+ unused). This is the LM
+    counterpart of the image pipeline's bundled-real-dataset fallback
+    (data/datasets.py Digits): the real-data oracle works in zero-egress
+    environments, e.g. on a source tree or any text dump.
+
+    max_tokens > 0 truncates (bounds memory for huge files)."""
+    if vocab < 256:
+        raise ValueError(f"byte-level corpus needs vocab >= 256, got {vocab}")
+    # count bounds the READ itself — slicing after a full np.fromfile would
+    # materialize a huge file before truncating.
+    data = np.fromfile(path, dtype=np.uint8,
+                       count=max_tokens if max_tokens else -1)
+    if len(data) == 0:
+        raise ValueError(f"{path} is empty")
+    return data.astype(np.int32)
+
+
 class TokenLoader:
     """Contiguous [B, S] windows over a token stream, shared-seed shuffled
     window order, per-host disjoint shards (the DataLoader discipline)."""

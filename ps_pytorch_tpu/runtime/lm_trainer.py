@@ -126,11 +126,27 @@ class LMTrainer:
         else:  # unreachable: TrainConfig.__post_init__ validates
             raise ValueError(self.mode)
 
-        stream = synthetic_tokens(cfg.lm_corpus_tokens, cfg.lm_vocab,
-                                  seed=cfg.seed)
+        if cfg.lm_corpus_file:
+            # Byte-level real corpus (tokens_from_file): any local file,
+            # no tokenizer, no network — the LM real-data path.
+            from ps_pytorch_tpu.data.text import tokens_from_file
+            stream = tokens_from_file(cfg.lm_corpus_file, cfg.lm_vocab,
+                                      max_tokens=cfg.lm_corpus_tokens)
+        else:
+            stream = synthetic_tokens(cfg.lm_corpus_tokens, cfg.lm_vocab,
+                                      seed=cfg.seed)
         # Held-out tail: last 10% of the stream never trains.
         cut = len(stream) - max(len(stream) // 10,
                                 (cfg.batch_size + 1) * cfg.lm_seq_len + 1)
+        if cut <= cfg.batch_size * cfg.lm_seq_len:
+            # Without this, a too-small corpus surfaces as a confusing
+            # "0 windows < global batch" TokenLoader error.
+            need = (2 * cfg.batch_size + 1) * cfg.lm_seq_len + 2
+            src = cfg.lm_corpus_file or "the synthetic stream"
+            raise ValueError(
+                f"corpus too small: {src} has {len(stream)} tokens but "
+                f"batch_size={cfg.batch_size} x lm_seq_len={cfg.lm_seq_len} "
+                f"plus the held-out tail needs roughly {need}")
         self.train_loader = TokenLoader(stream[:cut], cfg.batch_size,
                                         cfg.lm_seq_len, seed=cfg.seed)
         self.val_tokens = stream[cut:]

@@ -74,6 +74,38 @@ def test_lm_parallelism_modes_train_and_evaluate(tmp_path, mode, extra):
     assert r["loss"] < 0.5 * np.log(256), (mode, r)
 
 
+def test_tokens_from_file_bytes_and_validation(tmp_path):
+    from ps_pytorch_tpu.data.text import tokens_from_file
+
+    p = tmp_path / "corpus.bin"
+    p.write_bytes(bytes(range(256)) * 4)
+    toks = tokens_from_file(str(p))
+    assert toks.dtype == np.int32 and len(toks) == 1024
+    assert toks[:256].tolist() == list(range(256))
+    assert len(tokens_from_file(str(p), max_tokens=100)) == 100
+    with pytest.raises(ValueError, match="vocab"):
+        tokens_from_file(str(p), vocab=64)
+    empty = tmp_path / "empty.bin"
+    empty.write_bytes(b"")
+    with pytest.raises(ValueError, match="empty"):
+        tokens_from_file(str(empty))
+
+
+def test_lm_trains_on_real_byte_corpus(tmp_path):
+    """The real-data LM path: a byte-level corpus from an actual file must
+    train below the uniform floor (repetitive text, so it is learnable in
+    few steps)."""
+    from ps_pytorch_tpu.runtime.lm_trainer import LMTrainer
+
+    corpus = tmp_path / "corpus.txt"
+    corpus.write_bytes(("".join(f"line {i % 7} of the corpus\n"
+                                for i in range(8000))).encode())
+    t = LMTrainer(_cfg(tmp_path, lm_corpus_file=str(corpus), max_steps=30))
+    t.train()
+    r = t.evaluate(max_batches=2)
+    assert r["loss"] < 0.4 * np.log(256), r
+
+
 def test_lm_parallelism_resume_same_mode(tmp_path):
     from ps_pytorch_tpu.runtime.lm_trainer import LMTrainer
 
