@@ -55,6 +55,32 @@ def tokens_from_file(path: str, vocab: int = 256,
     return data.astype(np.int32)
 
 
+def lm_streams(cfg) -> Tuple[np.ndarray, np.ndarray]:
+    """(train_tokens, val_tokens) for a TrainConfig — THE train/held-out
+    split, shared by the LMTrainer and the standalone evaluator so both
+    score the same tail. Corpus file (byte-level real data) when set, else
+    the synthetic Markov stream."""
+    if cfg.lm_corpus_file:
+        stream = tokens_from_file(cfg.lm_corpus_file, cfg.lm_vocab,
+                                  max_tokens=cfg.lm_corpus_tokens)
+    else:
+        stream = synthetic_tokens(cfg.lm_corpus_tokens, cfg.lm_vocab,
+                                  seed=cfg.seed)
+    # Held-out tail: last 10% of the stream never trains.
+    cut = len(stream) - max(len(stream) // 10,
+                            (cfg.batch_size + 1) * cfg.lm_seq_len + 1)
+    if cut <= cfg.batch_size * cfg.lm_seq_len:
+        # Without this, a too-small corpus surfaces as a confusing
+        # "0 windows < global batch" TokenLoader error.
+        need = (2 * cfg.batch_size + 1) * cfg.lm_seq_len + 2
+        src = cfg.lm_corpus_file or "the synthetic stream"
+        raise ValueError(
+            f"corpus too small: {src} has {len(stream)} tokens but "
+            f"batch_size={cfg.batch_size} x lm_seq_len={cfg.lm_seq_len} "
+            f"plus the held-out tail needs roughly {need}")
+    return stream[:cut], stream[cut:]
+
+
 class TokenLoader:
     """Contiguous [B, S] windows over a token stream, shared-seed shuffled
     window order, per-host disjoint shards (the DataLoader discipline)."""

@@ -2,3 +2,7 @@ from ps_pytorch_tpu.parallel.mesh import make_mesh  # noqa: F401
 from ps_pytorch_tpu.parallel.dp import TrainState, create_train_state, make_train_step, make_eval_step  # noqa: F401
 from ps_pytorch_tpu.parallel.ring import ring_attention, full_attention, make_ring_attention  # noqa: F401
 from ps_pytorch_tpu.parallel.sp import create_lm_train_state, make_sp_train_step  # noqa: F401
+# tp/pp/ep/zero are imported from their submodules by their consumers
+# (lm_trainer selects them lazily per mode) — no eager re-export here:
+# every `from ps_pytorch_tpu.parallel import dist` would otherwise pay
+# their import cost for nothing.

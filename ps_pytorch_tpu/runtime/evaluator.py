@@ -25,6 +25,8 @@ from ps_pytorch_tpu.parallel.dp import replica0_batch_stats
 from ps_pytorch_tpu.runtime import checkpoint as ckpt
 
 EVAL_LINE = "EVAL step {step} loss {loss:.6f} prec1 {prec1:.4f} prec5 {prec5:.4f}"
+EVAL_LM_LINE = "EVAL_LM step {step} loss {loss:.6f} perplexity {perplexity:.3f}"
+_LM_NETWORKS = ("TransformerLM", "MoETransformerLM")
 
 
 def accumulate_eval(eval_fn, params, bstats, batches, max_batches=None) -> dict:
@@ -52,10 +54,16 @@ class Evaluator:
         self.printer = printer
         self.download = download
         self._built_for: Optional[str] = None
+        self._lm = False
 
     def _build(self, config_json: str):
         cfg = TrainConfig.from_json(config_json)
         self.cfg = cfg
+        self._lm = cfg.network in _LM_NETWORKS
+        if self._lm:
+            self._build_lm(cfg)
+            self._built_for = config_json
+            return
         self.model = build_model(cfg.network, cfg.num_classes, cfg.compute_dtype)
         # Template state for deserialization; single-device mesh is fine here.
         mesh = make_mesh(data=1)
@@ -68,12 +76,93 @@ class Evaluator:
         self.eval_fn = make_eval_step(self.model, input_norm_for(cfg))
         self._built_for = config_json
 
+    def _build_lm(self, cfg: TrainConfig):
+        """LM checkpoints (train_lm.py): held-out next-token loss /
+        perplexity. The checkpoint's config is self-describing (model
+        family in ``network``, resolved ``lm_model_axis`` for pp).
+
+        sp checkpoints evaluate through the SHARDED ring-attention forward
+        over this host's devices when the sequence shards evenly — the
+        unsharded fallback materializes [S, S] attention, the OOM the sp
+        mode exists to avoid, so it is only used when ring sharding is
+        impossible (one device, or indivisible sequence)."""
+        from ps_pytorch_tpu.data.text import TokenLoader, lm_streams
+        from ps_pytorch_tpu.optim import build_schedule
+        from ps_pytorch_tpu.optim.sgd import sgd
+        from ps_pytorch_tpu.parallel.dp import TrainState
+        from ps_pytorch_tpu.runtime.lm_eval import build_lm_oracle, lm_geometry
+
+        self._lm_sp_eval = None
+        n = len(jax.devices())
+        if (cfg.lm_parallelism == "sp" and n > 1
+                and cfg.lm_seq_len % n == 0):
+            import numpy as np
+            from jax.sharding import Mesh
+            from ps_pytorch_tpu.models.transformer import TransformerLM
+            from ps_pytorch_tpu.parallel.sp import make_sp_eval_fn
+            mesh = Mesh(np.array(jax.devices()), ("data",))
+            ring = TransformerLM(attention_impl="ring", axis_name="data",
+                                 **lm_geometry(cfg))
+            self._lm_sp_eval = (make_sp_eval_fn(ring, mesh), mesh)
+        loss_fn, to_tree = build_lm_oracle(cfg)
+
+        # Template state for deserialization: same model family + same
+        # optimizer construction as LMTrainer, so the tree matches.
+        from ps_pytorch_tpu.models.transformer import TransformerLM
+        geo = lm_geometry(cfg)
+        if cfg.network == "MoETransformerLM":
+            from ps_pytorch_tpu.models.moe import MoETransformerLM
+            model = MoETransformerLM(n_experts=cfg.lm_experts, **geo)
+        else:
+            model = TransformerLM(**geo)
+        init_len = min(cfg.lm_seq_len, 128)
+        params = model.init(jax.random.key(0),
+                            jnp.zeros((1, init_len), jnp.int32),
+                            positions=jnp.arange(init_len))["params"]
+        if cfg.lm_parallelism == "pp":
+            from ps_pytorch_tpu.parallel.pp import stack_stage_params
+            params = stack_stage_params(params, cfg.lm_model_axis)
+        tx = sgd(lr=build_schedule(cfg), momentum=cfg.momentum,
+                 weight_decay=cfg.weight_decay, nesterov=cfg.nesterov)
+        self.template = TrainState(step=jnp.zeros((), jnp.int32),
+                                   params=params, opt_state=tx.init(params),
+                                   batch_stats={})
+        _, val = lm_streams(cfg)
+        self._lm_val = TokenLoader(val, cfg.batch_size, cfg.lm_seq_len,
+                                   seed=0, shuffle=False)
+        self._lm_to_tree = to_tree
+        self._lm_loss = loss_fn
+
+    def _evaluate_lm_step(self, step: int) -> dict:
+        from ps_pytorch_tpu.parallel import dist
+        from ps_pytorch_tpu.runtime.lm_eval import perplexity
+
+        state, _, _ = ckpt.load_checkpoint(self.train_dir, step,
+                                           self.template)
+        params = self._lm_to_tree(state.params)
+        losses = []
+        for t in self._lm_val.epoch(0):
+            if self._lm_sp_eval is not None:
+                from jax.sharding import PartitionSpec as P
+                eval_fn, mesh = self._lm_sp_eval
+                tok = dist.globalize_replicated(mesh, t,
+                                                spec=P(None, "data"))
+                losses.append(float(eval_fn(params, tok)))
+            else:
+                losses.append(float(self._lm_loss(params, jnp.asarray(t))))
+        loss = sum(losses) / max(len(losses), 1)
+        result = {"step": step, "loss": loss, "perplexity": perplexity(loss)}
+        self.printer(EVAL_LM_LINE.format(**result))
+        return result
+
     def evaluate_step(self, step: int) -> dict:
         path = ckpt.checkpoint_path(self.train_dir, step)
         with open(f"{path}/config.json") as f:
             config_json = f.read()
         if config_json != self._built_for:
             self._build(config_json)
+        if self._lm:
+            return self._evaluate_lm_step(step)
         state, meta, _ = ckpt.load_checkpoint(self.train_dir, step, self.template)
         result = accumulate_eval(self.eval_fn, state.params,
                                  replica0_batch_stats(state),

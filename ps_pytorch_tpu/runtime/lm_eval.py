@@ -1,0 +1,66 @@
+"""Shared LM evaluation oracle — ONE definition of the held-out next-token
+loss for a checkpointed LM, used by both the in-trainer eval
+(``lm_trainer.LMTrainer.evaluate``) and the standalone polling evaluator
+(``evaluator.Evaluator``). Keeping the apply-dispatch (plain / pp-unstack /
+MoE), the loss framing (logits[:, :-1] vs tokens[:, 1:]), and the
+perplexity clamp in one place means the trainer's EVAL and the evaluator's
+EVAL_LM can never silently diverge for the same checkpoint.
+
+The config is self-describing (``network`` holds the model family,
+``lm_model_axis`` the RESOLVED pp stage count — lm_trainer writes both
+into the checkpoint).
+"""
+
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+_LM_NETWORKS = ("TransformerLM", "MoETransformerLM")
+
+
+def perplexity(loss: float) -> float:
+    return float(jnp.exp(min(loss, 30.0)))
+
+
+def lm_geometry(cfg) -> dict:
+    return dict(vocab_size=cfg.lm_vocab, d_model=cfg.lm_d_model,
+                n_layers=cfg.lm_layers, n_heads=cfg.lm_heads,
+                max_seq_len=cfg.lm_seq_len)
+
+
+def build_lm_oracle(cfg) -> Tuple[Callable, Callable]:
+    """-> (loss_fn(params, tokens) jitted, to_tree(saved_params)).
+
+    ``to_tree`` maps the checkpoint's param layout to the plain model tree
+    (pp checkpoints store stage-stacked blocks). EP note: the oracle
+    dispatches in ONE capacity group, while EP training grouped per device
+    — only WHICH overflow tokens drop can differ (models/moe.py)."""
+    from ps_pytorch_tpu.models.transformer import TransformerLM
+
+    geo = lm_geometry(cfg)
+    to_tree = lambda p: p
+    if cfg.network == "MoETransformerLM":
+        from ps_pytorch_tpu.models.moe import MoETransformerLM
+        model = MoETransformerLM(n_experts=cfg.lm_experts, **geo)
+        apply = lambda p, t: model.apply({"params": p}, t)[0]
+    else:
+        model = TransformerLM(**geo)
+        apply = lambda p, t: model.apply({"params": p}, t)
+    if cfg.lm_parallelism == "pp":
+        if cfg.lm_model_axis <= 0:
+            raise ValueError(
+                "pp checkpoint config has unresolved lm_model_axis=0 "
+                "(written before stage counts were recorded) — evaluate "
+                "in-trainer or pass the stage count explicitly")
+        from ps_pytorch_tpu.parallel.pp import unstack_stage_params
+        to_tree = unstack_stage_params
+
+    @jax.jit
+    def loss_fn(params, tokens):
+        logits = apply(params, tokens)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits[:, :-1], tokens[:, 1:]).mean()
+
+    return loss_fn, to_tree

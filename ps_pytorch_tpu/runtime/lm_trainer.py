@@ -29,7 +29,7 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ps_pytorch_tpu.config import TrainConfig
-from ps_pytorch_tpu.data.text import TokenLoader, synthetic_tokens
+from ps_pytorch_tpu.data.text import TokenLoader
 from ps_pytorch_tpu.models.transformer import TransformerLM
 from ps_pytorch_tpu.optim import build_schedule
 from ps_pytorch_tpu.optim.sgd import sgd
@@ -126,30 +126,20 @@ class LMTrainer:
         else:  # unreachable: TrainConfig.__post_init__ validates
             raise ValueError(self.mode)
 
-        if cfg.lm_corpus_file:
-            # Byte-level real corpus (tokens_from_file): any local file,
-            # no tokenizer, no network — the LM real-data path.
-            from ps_pytorch_tpu.data.text import tokens_from_file
-            stream = tokens_from_file(cfg.lm_corpus_file, cfg.lm_vocab,
-                                      max_tokens=cfg.lm_corpus_tokens)
-        else:
-            stream = synthetic_tokens(cfg.lm_corpus_tokens, cfg.lm_vocab,
-                                      seed=cfg.seed)
-        # Held-out tail: last 10% of the stream never trains.
-        cut = len(stream) - max(len(stream) // 10,
-                                (cfg.batch_size + 1) * cfg.lm_seq_len + 1)
-        if cut <= cfg.batch_size * cfg.lm_seq_len:
-            # Without this, a too-small corpus surfaces as a confusing
-            # "0 windows < global batch" TokenLoader error.
-            need = (2 * cfg.batch_size + 1) * cfg.lm_seq_len + 2
-            src = cfg.lm_corpus_file or "the synthetic stream"
-            raise ValueError(
-                f"corpus too small: {src} has {len(stream)} tokens but "
-                f"batch_size={cfg.batch_size} x lm_seq_len={cfg.lm_seq_len} "
-                f"plus the held-out tail needs roughly {need}")
-        self.train_loader = TokenLoader(stream[:cut], cfg.batch_size,
+        # Checkpoints are self-describing: record the model family and the
+        # RESOLVED mesh degree (lm_model_axis=0 means "all devices", which
+        # the standalone evaluator cannot know) into the config that
+        # save_checkpoint embeds.
+        resolved = {"network": ("MoETransformerLM" if self.mode == "ep"
+                                else "TransformerLM")}
+        if self.mode in ("tp", "pp"):
+            resolved["lm_model_axis"] = deg
+        self.cfg = cfg = cfg.replace(**resolved)
+
+        from ps_pytorch_tpu.data.text import lm_streams
+        train_stream, self.val_tokens = lm_streams(cfg)
+        self.train_loader = TokenLoader(train_stream, cfg.batch_size,
                                         cfg.lm_seq_len, seed=cfg.seed)
-        self.val_tokens = stream[cut:]
         self.metrics = MetricsLogger(cfg.metrics_file, cfg.log_every)
         self.start_step = 0
 
@@ -199,9 +189,14 @@ class LMTrainer:
             saved = {}
         # lm_model_axis matters for pp: blocks are stacked per stage, and a
         # different stage count would restore without shape validation and
-        # silently drop layers inside the step's per-stage slicing.
+        # silently drop layers inside the step's per-stage slicing. A saved
+        # value of 0 predates resolved recording ("all devices at save
+        # time") and cannot be compared — skip rather than spuriously
+        # reject.
         for k in ("lm_vocab", "lm_d_model", "lm_layers", "lm_heads",
                   "lm_parallelism", "lm_experts", "lm_model_axis"):
+            if k == "lm_model_axis" and saved.get(k) == 0:
+                continue
             if k in saved and saved[k] != getattr(self.cfg, k):
                 raise ValueError(
                     f"checkpoint in {self.cfg.train_dir} was written with "
@@ -258,33 +253,27 @@ class LMTrainer:
         """Grad-free eval for tp/pp/ep: gather params to their logical tree
         and run the plain (unsharded) model — fine at checkpoint cadence.
         SP keeps its sharded ring eval (a full-attention clone at the global
-        sequence length is exactly the OOM that mode exists to avoid)."""
-        import optax
-        if self.mode == "pp":
-            from ps_pytorch_tpu.parallel.pp import unstack_stage_params
-            to_tree = unstack_stage_params
-            model = self.model
-            apply = lambda p, t: model.apply({"params": p}, t)
-        elif self.mode == "ep":
-            # n_groups = data-axis size keeps the oracle's per-group
-            # capacity accounting identical to the sharded forward (the
-            # exactness models/moe.py is designed around); n_groups=1
-            # would capacity-drop a DIFFERENT token set than training.
+        sequence length is exactly the OOM that mode exists to avoid).
+
+        The loss itself comes from the SHARED oracle (runtime/lm_eval.py)
+        so the standalone evaluator's EVAL_LM can never diverge from this.
+        One trainer-only refinement for ep: live training knows the data
+        axis, so the oracle model regains per-device capacity grouping
+        (exact vs the sharded forward; the standalone evaluator documents
+        the one-group approximation instead)."""
+        from ps_pytorch_tpu.runtime.lm_eval import build_lm_oracle
+        loss_fn, to_tree = build_lm_oracle(self.cfg)
+        if self.mode == "ep":
+            import optax
             oracle = self.model.clone(ep_axis=None,
                                       n_groups=self.mesh.shape["data"],
                                       n_local_experts=None)
-            to_tree = lambda p: p
-            apply = lambda p, t: oracle.apply({"params": p}, t)[0]
-        else:  # tp — sharded but logically the plain tree
-            model = self.model
-            to_tree = lambda p: p
-            apply = lambda p, t: model.apply({"params": p}, t)
 
-        @jax.jit
-        def loss_fn(params, tokens):
-            logits = apply(params, tokens)
-            return optax.softmax_cross_entropy_with_integer_labels(
-                logits[:, :-1], tokens[:, 1:]).mean()
+            @jax.jit
+            def loss_fn(params, tokens):  # noqa: F811 — ep refinement
+                logits, _ = oracle.apply({"params": params}, tokens)
+                return optax.softmax_cross_entropy_with_integer_labels(
+                    logits[:, :-1], tokens[:, 1:]).mean()
 
         # all_replicated, not device_get: tp/pp/ep leaves are sharded over
         # devices that can span hosts.

@@ -106,6 +106,29 @@ def test_lm_trains_on_real_byte_corpus(tmp_path):
     assert r["loss"] < 0.4 * np.log(256), r
 
 
+@pytest.mark.parametrize("mode,extra", [
+    ("sp", {}),
+    ("pp", dict(lm_model_axis=4, lm_layers=4, lm_microbatches=2)),
+])
+def test_standalone_evaluator_scores_lm_checkpoints(tmp_path, mode, extra):
+    """The polling-evaluator contract (reference distributed_evaluator.py)
+    extends to LM checkpoints: self-describing config -> EVAL_LM line with
+    held-out loss below the uniform floor."""
+    from ps_pytorch_tpu.runtime.evaluator import Evaluator
+    from ps_pytorch_tpu.runtime.lm_trainer import LMTrainer
+    from ps_pytorch_tpu.runtime import checkpoint as ckpt
+
+    cfg = _cfg(tmp_path, lm_parallelism=mode, max_steps=30, eval_freq=30,
+               **extra)
+    LMTrainer(cfg).train()
+    step = ckpt.latest_step(str(tmp_path))
+    assert step == 30
+    lines = []
+    r = Evaluator(str(tmp_path), printer=lines.append).evaluate_step(step)
+    assert lines and lines[0].startswith(f"EVAL_LM step {step} loss ")
+    assert r["loss"] < 0.6 * np.log(256), (mode, r)
+
+
 def test_lm_parallelism_resume_same_mode(tmp_path):
     from ps_pytorch_tpu.runtime.lm_trainer import LMTrainer
 
