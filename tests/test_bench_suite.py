@@ -3,7 +3,9 @@
 
 import jax
 
-from bench_suite import CONFIGS, bench_throughput, bench_time_to_loss
+from bench_suite import (
+    CONFIGS, bench_moe_lm, bench_throughput, bench_time_to_loss,
+)
 
 
 def test_lenet_dp_config_runs():
@@ -30,7 +32,19 @@ def test_convergence_probe():
     assert r["converged"] and r["steps"] <= 10
 
 
+def test_moe_lm_config_runs():
+    r = bench_moe_lm("moe_lm_2k", 1, batch=8, seq_len=64, d_model=32,
+                     n_layers=1, n_heads=2, vocab=128, n_experts=8)
+    assert r["devices"] == 8 and r["n_experts"] == 8
+    assert r["tokens_per_sec"] > 0
+    # expert count rounds UP to a device-count multiple
+    r2 = bench_moe_lm("moe_lm_2k", 1, batch=8, seq_len=64, d_model=32,
+                      n_layers=1, n_heads=2, vocab=128, n_experts=3)
+    assert r2["n_experts"] == 8
+
+
 def test_all_configs_registered():
     assert set(CONFIGS) >= {
         "lenet_mnist_single", "lenet_mnist_dp", "resnet18_cifar10_dp",
-        "vgg11_cifar100_kofn", "resnet50_imagenet", "lenet_convergence"}
+        "vgg11_cifar100_kofn", "resnet50_imagenet", "lenet_convergence",
+        "moe_lm_2k", "transformer_lm_2k"}
