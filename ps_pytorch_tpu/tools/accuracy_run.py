@@ -24,6 +24,115 @@ import sys
 import time
 
 
+def _probe_platform():
+    """Platform probed in a TIMED child (importing jax in the harness could
+    hang if the TPU tunnel is down — the compute already happened in the
+    train/evaluate subprocesses either way)."""
+    try:
+        pr = subprocess.run(
+            [sys.executable, "-c",
+             "import os, jax\n"
+             "p = os.environ.get('PS_TPU_PLATFORM')\n"
+             "if p: jax.config.update('jax_platforms', p)\n"
+             "d = jax.devices()[0]; print(d.platform, d.device_kind)"],
+            capture_output=True, text=True, timeout=90)
+        return (pr.stdout.strip().split(" ", 1) + ["?"])[:2] \
+            if pr.returncode == 0 and pr.stdout.strip() else ("unknown", "?")
+    except subprocess.TimeoutExpired:
+        return "unknown", "?"
+
+
+def _write_source_corpus(repo: str, path: str) -> int:
+    """REAL byte corpus with zero egress: the framework's own source tree
+    (human-written Python), concatenated. ~hundreds of KB — far past the
+    LM's batch/seq/held-out geometry needs."""
+    parts = []
+    for top in ("ps_pytorch_tpu", "tests"):
+        for root, _, files in sorted(os.walk(os.path.join(repo, top))):
+            for f in sorted(files):
+                if f.endswith(".py"):
+                    with open(os.path.join(root, f), "rb") as fh:
+                        parts.append(fh.read())
+    data = b"\n".join(parts)
+    with open(path, "wb") as f:
+        f.write(data)
+    return len(data)
+
+
+# Matches finite AND nan/inf floats: a diverged run prints "loss nan" and
+# must be reported as divergence, not as "evaluate.py failed".
+_FLOAT = r"([\d.eE+-]+|nan|inf)"
+
+
+def _run_child(label: str, cmd, repo: str, timeout_s: float):
+    r = subprocess.run(cmd, capture_output=True, text=True,
+                       timeout=timeout_s, cwd=repo)
+    if r.returncode != 0:
+        raise RuntimeError(f"{label} failed rc={r.returncode}: "
+                           f"{(r.stderr or r.stdout)[-400:]}")
+    return r
+
+
+def _emit(result: dict, args, repo: str) -> dict:
+    print(json.dumps(result))
+    if args.out:
+        with open(os.path.join(repo, args.out) if not os.path.isabs(args.out)
+                  else args.out, "w") as f:
+            json.dump(result, f, indent=1)
+    return result
+
+
+def run_lm(args, repo: str) -> dict:
+    """LM real-data oracle: train_lm.py on a byte-level real corpus ->
+    checkpoint -> evaluate.py --once scores it (EVAL_LM line)."""
+    # Resolve harness-side paths against repo: the children run cwd=repo,
+    # so a relative --train-dir must mean the same directory to both.
+    train_dir = args.train_dir if os.path.isabs(args.train_dir) \
+        else os.path.join(repo, args.train_dir)
+    os.makedirs(train_dir, exist_ok=True)
+    corpus = os.path.join(train_dir, "corpus.bin")
+    corpus_bytes = _write_source_corpus(repo, corpus)
+    train_cmd = [
+        sys.executable, os.path.join(repo, "train_lm.py"),
+        "--lm-corpus-file", corpus, "--lm-seq-len", "256",
+        "--lm-d-model", "128", "--lm-layers", "2", "--lm-heads", "4",
+        "--batch-size", "16", "--momentum", "0.9",
+        # lr 0.1 + warmup + cosine: real source bytes are a harder stream
+        # than the synthetic Markov corpus — the synthetic recipe's lr 0.3
+        # diverged here (loss -> 1e15, observed).
+        "--lr", "0.1", "--lr-schedule", "cosine", "--lr-warmup-steps", "50",
+        "--max-steps", str(args.max_steps),
+        "--eval-freq", str(args.max_steps),    # one final checkpoint
+        "--log-every", "100", "--train-dir", train_dir,
+    ]
+    t0 = time.perf_counter()
+    _run_child("train_lm.py", train_cmd, repo, args.timeout_s)
+    train_s = time.perf_counter() - t0
+    ev = _run_child(
+        "evaluate.py",
+        [sys.executable, os.path.join(repo, "evaluate.py"),
+         "--train-dir", train_dir, "--once", str(args.max_steps)],
+        repo, args.timeout_s)
+    m = re.search(rf"EVAL_LM step (\d+) loss {_FLOAT} perplexity {_FLOAT}",
+                  ev.stdout)
+    if m is None:
+        raise RuntimeError(f"no EVAL_LM line in evaluate.py output: "
+                           f"{ev.stdout[-400:]}")
+    ppl = float(m.group(3))
+    platform, kind = _probe_platform()
+    return _emit({
+        "metric": "lm_time_to_perplexity",
+        "dataset": f"framework source bytes ({corpus_bytes} B)",
+        "network": "TransformerLM", "data": "real",
+        "steps": int(m.group(1)), "train_wall_s": round(train_s, 1),
+        "eval_loss": float(m.group(2)), "perplexity": ppl,
+        "target_perplexity": args.target_ppl,
+        "met_target": ppl <= args.target_ppl,
+        "platform": platform, "device_kind": kind,
+        "contract": "train_lm.py checkpoint -> evaluate.py --once (EVAL_LM)",
+    }, args, repo)
+
+
 def run(argv=None) -> dict:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--dataset", default="Digits")
@@ -32,6 +141,10 @@ def run(argv=None) -> dict:
     p.add_argument("--lr", type=float, default=0.01)
     p.add_argument("--max-steps", type=int, default=1200)
     p.add_argument("--target-prec1", type=float, default=0.98)
+    p.add_argument("--lm", action="store_true",
+                   help="LM oracle on a real byte corpus (the source tree) "
+                        "instead of the CNN/Digits oracle")
+    p.add_argument("--target-ppl", type=float, default=16.0)
     p.add_argument("--train-dir", default="./train_dir_accuracy")
     p.add_argument("--out", default="")
     p.add_argument("--timeout-s", type=float, default=1200.0)
@@ -39,6 +152,8 @@ def run(argv=None) -> dict:
 
     repo = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
+    if args.lm:
+        return run_lm(args, repo)
     train_cmd = [
         sys.executable, os.path.join(repo, "train.py"),
         "--dataset", args.dataset, "--network", args.network,
@@ -50,37 +165,23 @@ def run(argv=None) -> dict:
         "--log-every", "200", "--train-dir", args.train_dir,
     ]
     t0 = time.perf_counter()
-    tr = subprocess.run(train_cmd, capture_output=True, text=True,
-                        timeout=args.timeout_s, cwd=repo)
+    _run_child("train.py", train_cmd, repo, args.timeout_s)
     train_s = time.perf_counter() - t0
-    if tr.returncode != 0:
-        raise RuntimeError(f"train.py failed rc={tr.returncode}: "
-                           f"{(tr.stderr or tr.stdout)[-400:]}")
 
-    ev = subprocess.run(
+    ev = _run_child(
+        "evaluate.py",
         [sys.executable, os.path.join(repo, "evaluate.py"),
          "--train-dir", args.train_dir, "--once", str(args.max_steps)],
-        capture_output=True, text=True, timeout=args.timeout_s, cwd=repo)
-    m = re.search(r"EVAL step (\d+) loss ([\d.]+) prec1 ([\d.]+) prec5 ([\d.]+)",
-                  ev.stdout)
-    if ev.returncode != 0 or m is None:
-        raise RuntimeError(f"evaluate.py failed rc={ev.returncode}: "
-                           f"{(ev.stderr or ev.stdout)[-400:]}")
+        repo, args.timeout_s)
+    m = re.search(rf"EVAL step (\d+) loss {_FLOAT} prec1 {_FLOAT} "
+                  rf"prec5 {_FLOAT}", ev.stdout)
+    if m is None:
+        raise RuntimeError(f"no EVAL line in evaluate.py output: "
+                           f"{ev.stdout[-400:]}")
     prec1, prec5 = float(m.group(3)), float(m.group(4))
 
-    # Platform probed in a TIMED child (importing jax here could hang the
-    # harness if the TPU tunnel is down — the compute already happened in
-    # the train/evaluate subprocesses either way).
-    try:
-        pr = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; d=jax.devices()[0]; print(d.platform, d.device_kind)"],
-            capture_output=True, text=True, timeout=90)
-        platform, kind = (pr.stdout.strip().split(" ", 1) + ["?"])[:2] \
-            if pr.returncode == 0 and pr.stdout.strip() else ("unknown", "?")
-    except subprocess.TimeoutExpired:
-        platform, kind = "unknown", "?"
-    result = {
+    platform, kind = _probe_platform()
+    return _emit({
         "metric": "time_to_accuracy",
         "dataset": args.dataset, "network": args.network,
         "data": "real",
@@ -92,13 +193,7 @@ def run(argv=None) -> dict:
         "platform": platform,
         "device_kind": kind,
         "contract": "train.py checkpoint -> evaluate.py --once",
-    }
-    print(json.dumps(result))
-    if args.out:
-        with open(os.path.join(repo, args.out) if not os.path.isabs(args.out)
-                  else args.out, "w") as f:
-            json.dump(result, f, indent=1)
-    return result
+    }, args, repo)
 
 
 if __name__ == "__main__":
