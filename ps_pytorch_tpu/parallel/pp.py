@@ -21,9 +21,11 @@ Layout:
 
 Schedule: tick t has stage s processing microbatch (t - s); T = M + S - 1
 ticks total, the classic GPipe bubble of (S-1)/(M+S-1) idle fraction —
-documented cost, not hidden: utilization rises with M. Activations cross
-stages uncompressed over ICI (the reference's PS crossed the full gradient
-over TCP every step, SURVEY §2.3).
+documented cost, not hidden: utilization rises with M. Bubble ticks skip
+their block compute via ``lax.cond`` (zeros instead of garbage), so the
+bubble costs schedule latency but not FLOPs. Activations cross stages
+uncompressed over ICI (the reference's PS crossed the full gradient over
+TCP every step, SURVEY §2.3).
 
 Forward semantics are bit-compatible with ``models/transformer.TransformerLM``
 (same module math; `tests/test_pp.py` pins PP against the unsharded model).
@@ -220,19 +222,25 @@ def make_pp_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
             # s+1's tick-t input. (The wrap edge S-1 -> 0 carries bubble
             # garbage; stage 0 always overwrites it with a fresh embed.)
             # The ppermute stays UNconditional — every shard must execute
-            # the collective; only the collective-free embed/head work is
-            # gated behind lax.cond so non-edge stages skip it entirely
-            # (the head's vocab matmul is the largest matmul in the step).
+            # the collective; everything else (embed, the stage's blocks,
+            # the head) is collective-free and gated behind lax.cond.
             recv = jax.lax.ppermute(y_prev, axis_name, perm_fwd)
             mb_idx = t - s_idx            # microbatch this stage works on
             valid = (mb_idx >= 0) & (mb_idx < M)
             safe_idx = jnp.clip(mb_idx, 0, M - 1)
             my_tokens = micro[safe_idx]
             x_in = jax.lax.cond(
-                s_idx == 0,
+                valid & (s_idx == 0),
                 lambda: _embed(model, params, my_tokens).astype(recv.dtype),
                 lambda: recv)
-            y = _apply_stage(block, stage_params, x_in)
+            # Bubble ticks (the (S-1)/(M+S-1) idle fraction) skip embed and
+            # block compute entirely: their output is garbage consumed only
+            # by other bubble ticks, so zeros are just as good and cost
+            # nothing.
+            y = jax.lax.cond(
+                valid,
+                lambda: _apply_stage(block, stage_params, x_in),
+                lambda: jnp.zeros_like(x_in))
             # Last stage: loss for its (valid) microbatch.
             is_last = s_idx == n_stages - 1
             take = valid & is_last
