@@ -189,6 +189,9 @@ class MoETransformerLM(nn.Module):
     max_seq_len: int = 2048
     ep_axis: Optional[str] = None
     n_local_experts: Optional[int] = None
+    # Per-block remat (see models/transformer.py TransformerLM.remat); the
+    # recompute replays the block's all_to_alls, which is SPMD-legal.
+    remat: bool = False
     dtype: Any = jnp.float32
 
     @nn.compact
@@ -199,12 +202,13 @@ class MoETransformerLM(nn.Module):
                      name="tok_embed")(tokens)
         x = x + nn.Embed(self.max_seq_len, self.d_model, dtype=self.dtype,
                          name="pos_embed")(positions)[None]
+        Blk = nn.remat(MoEBlock) if self.remat else MoEBlock
         aux_total = jnp.float32(0.0)
         for i in range(self.n_layers):
-            x, aux = MoEBlock(self.n_heads, self.d_model, self.n_experts,
-                              self.capacity_factor, self.n_groups,
-                              self.ep_axis, self.n_local_experts,
-                              self.dtype, name=f"block_{i}")(x)
+            x, aux = Blk(self.n_heads, self.d_model, self.n_experts,
+                         self.capacity_factor, self.n_groups,
+                         self.ep_axis, self.n_local_experts,
+                         self.dtype, name=f"block_{i}")(x)
             aux_total = aux_total + aux
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
         logits = nn.Dense(self.vocab_size, use_bias=False, dtype=self.dtype,

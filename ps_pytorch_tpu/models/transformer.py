@@ -68,6 +68,12 @@ class TransformerLM(nn.Module):
     dtype: Any = jnp.float32
     attention_impl: str = "full"
     axis_name: str = "data"
+    # Per-BLOCK rematerialization: backward stores only block-boundary
+    # activations and recomputes each block's interior. Checkpointing any
+    # coarser (e.g. the whole loss) saves no peak memory — the recompute
+    # holds all residuals at once anyway. Param tree is unchanged, so
+    # remat can be toggled on an existing checkpoint.
+    remat: bool = False
 
     @nn.compact
     def __call__(self, tokens, positions: Optional[jax.Array] = None,
@@ -80,9 +86,10 @@ class TransformerLM(nn.Module):
                      name="tok_embed")(tokens)
         x = x + nn.Embed(self.max_seq_len, self.d_model, dtype=self.dtype,
                          name="pos_embed")(positions)[None]
+        Blk = nn.remat(Block) if self.remat else Block
         for i in range(self.n_layers):
-            x = Block(self.n_heads, self.d_model, self.dtype,
-                      self.attention_impl, self.axis_name, name=f"block_{i}")(x)
+            x = Blk(self.n_heads, self.d_model, self.dtype,
+                    self.attention_impl, self.axis_name, name=f"block_{i}")(x)
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
         logits = nn.Dense(self.vocab_size, use_bias=False, dtype=self.dtype,
                           name="lm_head")(x)

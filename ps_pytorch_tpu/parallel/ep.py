@@ -87,7 +87,7 @@ def create_ep_train_state(model, tx: optax.GradientTransformation,
 
 def make_ep_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
                        state: TrainState, *, axis: str = "data",
-                       aux_coef: float = 0.01,
+                       aux_coef: float = 0.01, remat: bool = False,
                        donate: bool = True) -> Callable:
     """-> step_fn(state, tokens) -> (state, {'loss', 'aux'}).
 
@@ -104,8 +104,11 @@ def make_ep_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
                          f"{n} devices")
     # flax validates stored param shapes against their declaration; inside
     # shard_map each device holds the local expert slice, so the module
-    # must declare the local count.
-    model = model.clone(n_local_experts=model.n_experts // n, n_groups=1)
+    # must declare the local count. remat is per-block (MoETransformerLM
+    # docstring) — the recompute replays the block's all_to_alls,
+    # SPMD-legal since every shard recomputes the same program.
+    model = model.clone(n_local_experts=model.n_experts // n, n_groups=1,
+                        remat=remat)
 
     def local_step(state, tokens):
         def loss_fn(params):

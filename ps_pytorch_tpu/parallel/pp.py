@@ -104,15 +104,19 @@ def _head(model, params, x):
     return dense.apply({"params": params["lm_head"]}, x).astype(jnp.float32)
 
 
-def _apply_stage(block_module: Block, stage_params, x):
+def _apply_stage(block_module: Block, stage_params, x, *,
+                 remat: bool = False):
     """Run this stage's ``layers_per_stage`` blocks sequentially.
 
     stage_params leaves: [layers_per_stage, ...] (stage axis already
-    squeezed by shard_map)."""
+    squeezed by shard_map). ``remat`` checkpoints each block, so backward
+    stores only block boundaries — the classic PP+remat memory shape."""
+    apply = lambda blk, x: block_module.apply({"params": blk}, x)
+    if remat:
+        apply = jax.checkpoint(apply)
     per = jax.tree.leaves(stage_params)[0].shape[0]
     for l in range(per):
-        blk = jax.tree.map(lambda a: a[l], stage_params)
-        x = block_module.apply({"params": blk}, x)
+        x = apply(jax.tree.map(lambda a: a[l], stage_params), x)
     return x
 
 
@@ -181,7 +185,7 @@ def create_pp_train_state(model, tx: optax.GradientTransformation,
 def make_pp_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
                        state: TrainState, *, num_microbatches: int,
                        axis_name: str = "model", data_axis: str = "data",
-                       donate: bool = True) -> Callable:
+                       remat: bool = False, donate: bool = True) -> Callable:
     """-> step_fn(state, tokens) -> (state, {'loss'}).
 
     tokens [B, S]: batch sharded over ``data_axis`` (size may be 1), every
@@ -239,7 +243,8 @@ def make_pp_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
             # nothing.
             y = jax.lax.cond(
                 valid,
-                lambda: _apply_stage(block, stage_params, x_in),
+                lambda: _apply_stage(block, stage_params, x_in,
+                                     remat=remat),
                 lambda: jnp.zeros_like(x_in))
             # Last stage: loss for its (valid) microbatch.
             is_last = s_idx == n_stages - 1

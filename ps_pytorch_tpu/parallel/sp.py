@@ -82,14 +82,22 @@ def _local_nexttoken_loss(model, axis_name: str, params, tokens):
 
 
 def make_sp_train_step(model, tx, mesh: Mesh, *, axis_name: str = "data",
-                       donate: bool = True) -> Callable:
+                       remat: bool = False, donate: bool = True) -> Callable:
     """-> step_fn(state, tokens) -> (state, metrics).
 
     tokens: [B, S] global int32, S sharded over ``axis_name``. The model must
     be built with ``attention_impl='ring'`` and the same ``axis_name``.
     (No rng parameter: the LM has no dropout yet; add an ``rngs`` dict to the
     apply call when it does.)
+
+    ``remat`` enables PER-BLOCK rematerialization (TransformerLM.remat —
+    backward stores only block boundaries; the long-context lever when S/N
+    activations still don't fit). The recomputation replays each block's
+    ring ppermutes, which is SPMD-legal because every shard recomputes the
+    same program.
     """
+    if remat:
+        model = model.clone(remat=True)
 
     def local_step(state, tokens):
         def loss_fn(params):

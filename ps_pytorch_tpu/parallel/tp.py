@@ -144,7 +144,7 @@ def create_tp_train_state(model, tx: optax.GradientTransformation,
 
 def make_tp_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
                        state: TrainState, *, axis: str = "model",
-                       donate: bool = True) -> Callable:
+                       remat: bool = False, donate: bool = True) -> Callable:
     """-> step_fn(state, tokens) -> (state, {'loss'}).
 
     tokens: [B, S] int32, batch sharded over 'data' (DP) while every weight
@@ -157,6 +157,12 @@ def make_tp_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
     if getattr(model, "attention_impl", "full") != "full":
         raise ValueError("TP step requires attention_impl='full' "
                          "(ring attention shards sequence, not heads)")
+
+    # Per-block remat (TransformerLM.remat): checkpointing the whole loss
+    # instead would save no peak memory (the recompute holds all residuals
+    # at once) while paying a full extra forward.
+    if remat:
+        model = model.clone(remat=True)
 
     def step(state, tokens):
         def loss_fn(params):

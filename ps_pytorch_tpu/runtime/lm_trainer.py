@@ -71,7 +71,9 @@ class LMTrainer:
                 self.model, self.tx, self.mesh,
                 (cfg.batch_size, cfg.lm_seq_len), key)
             self.step_fn = make_sp_train_step(self.model, self.tx,
-                                              self.mesh, donate=cfg.donate)
+                                              self.mesh,
+                                              remat=cfg.remat,
+                                              donate=cfg.donate)
             self.eval_fn = make_sp_eval_fn(self.model, self.mesh)
         elif self.mode in ("tp", "pp"):
             from ps_pytorch_tpu.parallel.mesh import make_mesh
@@ -91,7 +93,7 @@ class LMTrainer:
                     (cfg.batch_size, cfg.lm_seq_len), key)
                 self.step_fn = make_tp_train_step(
                     self.model, self.tx, self.mesh, self.state,
-                    donate=cfg.donate)
+                    remat=cfg.remat, donate=cfg.donate)
             else:
                 from ps_pytorch_tpu.parallel.pp import (
                     create_pp_train_state, make_pp_train_step,
@@ -105,7 +107,7 @@ class LMTrainer:
                 self.step_fn = make_pp_train_step(
                     self.model, self.tx, self.mesh, self.state,
                     num_microbatches=cfg.lm_microbatches,
-                    donate=cfg.donate)
+                    remat=cfg.remat, donate=cfg.donate)
             self.eval_fn = None   # oracle eval (see evaluate())
         elif self.mode == "ep":
             from ps_pytorch_tpu.models.moe import MoETransformerLM
@@ -121,7 +123,7 @@ class LMTrainer:
                 (cfg.batch_size, cfg.lm_seq_len), key)
             self.step_fn = make_ep_train_step(
                 self.model, self.tx, self.mesh, self.state,
-                donate=cfg.donate)
+                remat=cfg.remat, donate=cfg.donate)
             self.eval_fn = None
         else:  # unreachable: TrainConfig.__post_init__ validates
             raise ValueError(self.mode)

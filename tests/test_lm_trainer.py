@@ -129,6 +129,26 @@ def test_standalone_evaluator_scores_lm_checkpoints(tmp_path, mode, extra):
     assert r["loss"] < 0.6 * np.log(256), (mode, r)
 
 
+@pytest.mark.parametrize("mode,extra", [
+    ("sp", {}),
+    ("tp", dict(lm_model_axis=4)),
+    ("pp", dict(lm_model_axis=4, lm_layers=4, lm_microbatches=2)),
+    ("ep", dict(lm_experts=8)),
+])
+def test_lm_remat_is_numerically_identical(tmp_path, mode, extra):
+    """--remat trades FLOPs for activation memory; it must not change the
+    math (same seed + batches -> same held-out loss)."""
+    from ps_pytorch_tpu.runtime.lm_trainer import LMTrainer
+
+    losses = {}
+    for remat in (False, True):
+        t = LMTrainer(_cfg(tmp_path / f"r{remat}", lm_parallelism=mode,
+                           max_steps=4, remat=remat, **extra))
+        t.train()
+        losses[remat] = t.evaluate(max_batches=1)["loss"]
+    np.testing.assert_allclose(losses[True], losses[False], rtol=1e-5)
+
+
 def test_lm_parallelism_resume_same_mode(tmp_path):
     from ps_pytorch_tpu.runtime.lm_trainer import LMTrainer
 
