@@ -169,6 +169,13 @@ def _run_attempt(label: str, env_overrides: dict, timeout_s: float,
     """Run one child measurement under a hard timeout.
     -> (parsed JSON dict or None, error string or None)."""
     env = dict(os.environ)
+    # Persistent compile cache: if an earlier session already compiled
+    # these programs (tools_tpu_batch.sh populates the same dir), the
+    # child's first step loads the executable instead of re-lowering —
+    # the difference between fitting in a flaky tunnel window and not.
+    env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                   os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".jax_cache"))
     env.update(env_overrides)
     cmd = [sys.executable, os.path.abspath(__file__), "--child",
            "--per-device-batch", str(per_device_batch), "--steps", str(steps),
