@@ -97,10 +97,31 @@ def globalize_replicated(mesh: Mesh, value: np.ndarray,
 
 
 def all_replicated(mesh: Mesh, tree: Any) -> Any:
-    """Fetch a (possibly 'data'-sharded) pytree to every host as replicated
-    host-local numpy — used to pull replica-0 BN stats for checkpointing when
-    device 0 lives on another host."""
+    """Fetch a (possibly sharded) pytree of GLOBAL arrays to every host as
+    host-local numpy in the logical (full) shapes — the collective gather
+    behind LM checkpointing/eval when tp/pp/ep shard state across hosts.
+
+    Per leaf: fully-replicated arrays are read from a local shard (no
+    collective); sharded arrays are assembled with ``process_allgather
+    (tiled=True)`` — the only mode that accepts global non-fully-
+    addressable arrays (tiled=False raises; caught by the 2-process LM
+    test). ALL hosts must call this (the sharded case is collective)."""
     if jax.process_count() == 1:
         return jax.device_get(tree)
     from jax.experimental import multihost_utils
-    return jax.device_get(multihost_utils.process_allgather(tree, tiled=False))
+
+    def fetch(x):
+        if not isinstance(x, jax.Array):
+            return x
+        if x.is_fully_replicated:
+            return np.asarray(x.addressable_data(0))
+        if x.is_fully_addressable:
+            # A host-LOCAL sharded array here would silently gather to
+            # [nproc*d0, ...] (process_allgather's fully-addressable branch
+            # concatenates per-process copies) — corrupt, not an error.
+            raise ValueError(
+                "all_replicated expects GLOBAL arrays placed on the shared "
+                f"mesh; got a host-local sharded array {x.shape}")
+        return multihost_utils.process_allgather(x, tiled=True)
+
+    return jax.tree.map(fetch, tree)

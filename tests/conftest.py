@@ -35,3 +35,12 @@ def mesh8():
 @pytest.fixture()
 def rng():
     return np.random.default_rng(0)
+
+
+def free_port() -> int:
+    """An OS-assigned free TCP port for launch-driven multi-process tests
+    (single definition — was copy-pasted per test file)."""
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]

@@ -8,18 +8,15 @@ demonstrated in-process (VERDICT missing-item 3).
 
 import json
 import pathlib
-import socket
 
 import numpy as np
 import pytest
 
+from conftest import free_port
+
 REPO = pathlib.Path(__file__).resolve().parent.parent
 
 
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
 
 
 def test_async_trainer_single_process_smoke(tmp_path):
@@ -84,7 +81,7 @@ def test_async_two_processes_with_resume(tmp_path):
     def run(run_dir, max_steps, resume):
         rc = launch.main([
             "launch", "--run-dir", str(run_dir), "--simulate", "2",
-            "--devices-per-host", "4", "--port", str(_free_port()),
+            "--devices-per-host", "4", "--port", str(free_port()),
             "--entry", str(REPO / "train.py"), "--cwd", str(REPO),
             "--wait", "--timeout", "600",
             "--",

@@ -4,20 +4,17 @@ multi-host launcher driving a REAL 2-process x 4-fake-device distributed run
 
 import json
 import os
-import socket
 import subprocess
 import sys
 from pathlib import Path
 
 import pytest
 
+from conftest import free_port
+
 REPO = Path(__file__).resolve().parents[1]
 
 
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
 
 
 # ---------------------------------------------------------------- analyze --
@@ -93,7 +90,7 @@ def test_launch_simulated_pod(tmp_path):
     ckpt_dir = tmp_path / "ckpt"
     rc = launch.main([
         "launch", "--run-dir", str(run_dir), "--simulate", "2",
-        "--devices-per-host", "4", "--port", str(_free_port()),
+        "--devices-per-host", "4", "--port", str(free_port()),
         "--entry", str(REPO / "train.py"), "--cwd", str(REPO),
         "--wait", "--timeout", "600",
         "--",
@@ -172,7 +169,7 @@ def test_kofn_excludes_injected_straggler(tmp_path):
     run_dir = tmp_path / "run"
     rc = launch.main([
         "launch", "--run-dir", str(run_dir), "--simulate", "2",
-        "--devices-per-host", "4", "--port", str(_free_port()),
+        "--devices-per-host", "4", "--port", str(free_port()),
         "--entry", str(REPO / "train.py"), "--cwd", str(REPO),
         "--wait", "--timeout", "600",
         "--",
@@ -211,7 +208,7 @@ def test_kill_and_resume(tmp_path):
             str(ckpt), "--compute-dtype", "float32", "--resume", "true"]
     rc = launch.main([
         "launch", "--run-dir", str(run1), "--simulate", "2",
-        "--devices-per-host", "4", "--port", str(_free_port()),
+        "--devices-per-host", "4", "--port", str(free_port()),
         "--entry", str(REPO / "train.py"), "--cwd", str(REPO),
         "--", "--max-steps", "50"] + args)
     assert rc == 0
@@ -231,7 +228,7 @@ def test_kill_and_resume(tmp_path):
     # Relaunch: must RESUME (not restart at step 1) and finish.
     rc = launch.main([
         "launch", "--run-dir", str(run2), "--simulate", "2",
-        "--devices-per-host", "4", "--port", str(_free_port()),
+        "--devices-per-host", "4", "--port", str(free_port()),
         "--entry", str(REPO / "train.py"), "--cwd", str(REPO),
         "--wait", "--timeout", "600",
         "--", "--max-steps", str(resumed_from + 4)] + args)
