@@ -17,8 +17,6 @@ from conftest import free_port
 REPO = pathlib.Path(__file__).resolve().parent.parent
 
 
-
-
 def test_async_trainer_single_process_smoke(tmp_path):
     """AsyncTrainer with n=1 (leader-only, in-process KVStore): the full
     submit->poll->pool->update->publish cycle must run and learn."""

@@ -134,6 +134,15 @@ def test_standalone_evaluator_scores_lm_checkpoints(tmp_path, mode, extra):
     assert r["loss"] < 0.6 * np.log(256), (mode, r)
 
 
+def _assert_final_agrees(leader: str, follower: str, dump: str) -> None:
+    """Both processes printed a FINAL line and they are identical (the
+    state is replicated/consistently sharded at the end)."""
+    assert "FINAL" in leader and "FINAL" in follower, dump
+    fin_l = [l for l in leader.splitlines() if l.startswith("FINAL")][-1]
+    fin_f = [l for l in follower.splitlines() if l.startswith("FINAL")][-1]
+    assert fin_l == fin_f, dump
+
+
 def _launch_lm_2proc(tmp_path, extra_flags, max_steps=10):
     from ps_pytorch_tpu.tools import launch
 
@@ -169,11 +178,8 @@ def test_lm_two_process_sequence_parallel(tmp_path):
     assert rc == 0, dump
     leader, follower = logs[0].read_text(), logs[1].read_text()
     assert "attention=ring" in leader, dump
-    assert "FINAL" in leader and "FINAL" in follower, dump
     # Replicated state at both ends: the held-out eval agrees exactly.
-    fin_l = [l for l in leader.splitlines() if l.startswith("FINAL")][-1]
-    fin_f = [l for l in follower.splitlines() if l.startswith("FINAL")][-1]
-    assert fin_l == fin_f, dump
+    _assert_final_agrees(leader, follower, dump)
     # Leader-only write, collective gather: exactly one committed step.
     assert (ckpt / "model_step_10").is_dir(), dump
 
@@ -192,10 +198,25 @@ def test_lm_two_process_pipeline_sharded_gather(tmp_path):
     assert rc == 0, dump
     leader, follower = logs[0].read_text(), logs[1].read_text()
     assert "parallelism=pp" in leader, dump
-    assert "FINAL" in leader and "FINAL" in follower, dump
-    fin_l = [l for l in leader.splitlines() if l.startswith("FINAL")][-1]
-    fin_f = [l for l in follower.splitlines() if l.startswith("FINAL")][-1]
-    assert fin_l == fin_f, dump
+    _assert_final_agrees(leader, follower, dump)
+    assert (ckpt / "model_step_6").is_dir(), dump
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("mode,flags", [
+    ("tp", ["--lm-parallelism", "tp", "--lm-model-axis", "4"]),
+    ("ep", ["--lm-parallelism", "ep", "--lm-experts", "8"]),
+])
+def test_lm_two_process_tp_ep(tmp_path, mode, flags):
+    """tp over 2 OS processes proves GSPMD collectives across a real
+    process boundary; ep proves the MoE dispatch all_to_all crossing
+    processes (the DeepSpeed-MoE wire pattern). Both end with identical
+    FINAL lines on each process and a committed checkpoint."""
+    rc, ckpt, logs, dump = _launch_lm_2proc(tmp_path, flags, max_steps=6)
+    assert rc == 0, dump
+    leader, follower = logs[0].read_text(), logs[1].read_text()
+    assert f"parallelism={mode}" in leader, dump
+    _assert_final_agrees(leader, follower, dump)
     assert (ckpt / "model_step_6").is_dir(), dump
 
 

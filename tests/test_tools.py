@@ -15,8 +15,6 @@ from conftest import free_port
 REPO = Path(__file__).resolve().parents[1]
 
 
-
-
 # ---------------------------------------------------------------- analyze --
 
 def _write_jsonl(path, step_times, host=0):
