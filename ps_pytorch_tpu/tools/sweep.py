@@ -12,6 +12,11 @@ parser is shared with the analysis tooling.
     python -m ps_pytorch_tpu.tools.sweep --lrs 0.01,0.05,0.1 --probe-step 20 \
         -- --network LeNet --dataset synthetic_mnist --batch-size 256
 
+The same harness sweeps the LM entry point (both emit the STEP schema):
+
+    python -m ps_pytorch_tpu.tools.sweep --entry train_lm.py \
+        --lrs 0.05,0.1,0.3 -- --lm-seq-len 1024 --batch-size 8
+
 Prints one JSON line per trial and a final ``BEST`` line.
 """
 
