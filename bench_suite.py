@@ -243,7 +243,7 @@ def bench_async_multislice(name, steps, *, network="ResNet18",
 
 
 def bench_transformer_lm(name, steps, *, batch=8, seq_len=2048, d_model=512,
-                         n_layers=8, n_heads=8, vocab=32000):
+                         n_layers=8, n_heads=8, vocab=32000, remat=False):
     """Transformer-LM training throughput (tokens/sec) — the long-context
     surface (SURVEY: SP/ring attention first-class) benched next to the CNN
     rows. Single-axis mesh over all devices; ring attention shards the
@@ -270,7 +270,7 @@ def bench_transformer_lm(name, steps, *, batch=8, seq_len=2048, d_model=512,
                       lr=0.01, momentum=0.9)
     tx = build_optimizer(cfg)
     state = create_lm_train_state(model, tx, mesh, (batch, seq_len))
-    step_fn = make_sp_train_step(model, tx, mesh)
+    step_fn = make_sp_train_step(model, tx, mesh, remat=remat)
     rng = np.random.default_rng(0)
     tokens = jnp.asarray(rng.integers(0, vocab, size=(batch, seq_len)),
                          jnp.int32)
@@ -286,7 +286,7 @@ def bench_transformer_lm(name, steps, *, batch=8, seq_len=2048, d_model=512,
     return {"config": name, "attention": impl,
             "platform": jax.devices()[0].platform, "devices": n,
             "batch": batch, "seq_len": seq_len, "d_model": d_model,
-            "n_layers": n_layers,
+            "n_layers": n_layers, "remat": remat,
             "sec_per_step": round(dt, 5),
             "tokens_per_sec": round(toks / dt, 1),
             "loss": round(float(m["loss"]), 4)}
@@ -420,6 +420,10 @@ CONFIGS = {
         "resnet18_async_2slice", steps),
     "transformer_lm_2k": lambda steps: bench_transformer_lm(
         "transformer_lm_2k", steps),
+    # remat cost on the LM (the CNN ladder has resnet18_remat): per-block
+    # recompute tax in tokens/sec at the same geometry.
+    "transformer_lm_2k_remat": lambda steps: bench_transformer_lm(
+        "transformer_lm_2k_remat", steps, remat=True),
     "moe_lm_2k": lambda steps: bench_moe_lm("moe_lm_2k", steps),
     "lenet_convergence": lambda steps: bench_time_to_loss(
         "lenet_convergence", "LeNet", "synthetic_mnist", 512,
