@@ -92,6 +92,7 @@ class TrainConfig:
     lm_model_axis: int = 0           # tp/pp: size of the 'model' mesh axis (0 = all devices)
     lm_microbatches: int = 4         # pp: GPipe microbatch count
     lm_experts: int = 8              # ep: expert count (divisible by device count)
+    lm_moe_top_k: int = 1            # ep: 1 = switch routing, 2 = GShard top-2
 
     # -- fault injection (tests / straggler drills; SURVEY §5.3: the
     #    reference had none) --

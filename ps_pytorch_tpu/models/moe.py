@@ -1,14 +1,17 @@
-"""Mixture-of-Experts transformer LM (switch-style top-1 routing).
+"""Mixture-of-Experts transformer LM (switch top-1 or GShard top-2 routing).
 
 Beyond-parity model family backing expert parallelism (``parallel/ep.py``;
 the reference has no MoE or EP anywhere, SURVEY §2.5). Design points:
 
-- **Top-1 (switch) routing** with a per-expert capacity: each token goes to
-  its argmax expert; tokens beyond ``capacity = ceil(tokens/expert *
-  capacity_factor)`` are dropped (their MLP output is zero — the residual
-  stream carries them unchanged). Gradients flow through the gate
-  probability (argmax itself is non-differentiable), the standard switch
-  estimator.
+- **Routing** with a per-expert capacity: ``top_k=1`` (switch) sends each
+  token to its argmax expert, gate = the raw top probability; ``top_k=2``
+  (GShard) sends it to its two best experts with gates renormalized over
+  the pair, and first choices claim capacity slots before second choices
+  (rank-priority dispatch — overflow drops second choices first). Tokens
+  beyond ``capacity = ceil(tokens/expert * capacity_factor)`` are dropped
+  (their MLP output is zero — the residual stream carries them unchanged).
+  Gradients flow through the gate probabilities (top-k selection itself is
+  non-differentiable), the standard switch/GShard estimator.
 - **Per-group dispatch** (``n_groups``): capacity accounting runs
   independently per contiguous token group. Under expert parallelism each
   device is one group, so the unsharded oracle with ``n_groups = n_devices``
@@ -38,7 +41,10 @@ from ps_pytorch_tpu.parallel.ring import full_attention
 
 
 class MoEMLP(nn.Module):
-    """Switch MLP: route each token to 1 of ``n_experts`` expert FFNs."""
+    """MoE MLP: route each token to its top ``top_k`` of ``n_experts``
+    expert FFNs — switch-style (top_k=1, gate = raw top probability) or
+    GShard-style (top_k=2, gates renormalized over the selected pair,
+    first choices claim capacity slots before second choices)."""
     n_experts: int
     d_model: int
     d_hidden: int
@@ -49,6 +55,7 @@ class MoEMLP(nn.Module):
     # validates stored param shapes against their declaration, so the
     # declaration must say the LOCAL count (parallel/ep.py sets this).
     n_local_experts: Optional[int] = None
+    top_k: int = 1
     dtype: Any = jnp.float32
 
     @nn.compact
@@ -71,25 +78,43 @@ class MoEMLP(nn.Module):
         tg = t // g
         cap = max(math.ceil(tg / e * self.capacity_factor), 1)
 
+        if self.top_k not in (1, 2):
+            raise ValueError(f"top_k must be 1 or 2, got {self.top_k}")
         router = nn.Dense(e, use_bias=False, dtype=self.dtype,
                           name="router")(tokens)      # [T, E]
         probs = jax.nn.softmax(router.astype(jnp.float32), axis=-1)
-        gate = jnp.max(probs, axis=-1)                # [T]
-        expert_idx = jnp.argmax(probs, axis=-1)       # [T]
+        top_gates, top_idx = jax.lax.top_k(probs, self.top_k)  # [T, k]
+        if self.top_k > 1:
+            # GShard: gates renormalized over the selected experts. (For
+            # top_k=1 the raw probability is kept — normalizing would make
+            # every gate 1.0 and change switch semantics.)
+            top_gates = top_gates / jnp.sum(top_gates, axis=-1,
+                                            keepdims=True)
 
-        # Per-group dispatch: position of each token in its expert's queue,
-        # counted within the group; tokens past capacity are dropped.
-        onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)  # [T, E]
-        oh_g = onehot.reshape(g, tg, e)
-        pos = jnp.cumsum(oh_g, axis=1) - oh_g         # [G, TG, E]
-        pos = jnp.sum(pos * oh_g, axis=-1)            # [G, TG] queue pos
-        keep = (pos < cap).reshape(t)                 # [T]
-        slot = jax.nn.one_hot(pos.reshape(t).astype(jnp.int32), cap,
-                              dtype=jnp.float32)
-        # dispatch [G, TG, E, C]: one-hot of (expert, slot) for kept tokens
-        disp = (onehot * keep[:, None])[:, :, None] * slot[:, None, :]
-        disp = disp.reshape(g, tg, e, cap)
+        # Per-group dispatch with RANK PRIORITY: rank-0 (first-choice)
+        # assignments claim each expert's capacity slots before rank-1, so
+        # overflow drops second choices first (GShard's ordering). Each
+        # rank's queue positions are offset by the counts the earlier
+        # ranks already enqueued.
         xg = tokens.reshape(g, tg, d)
+        counts = jnp.zeros((g, 1, e), jnp.float32)    # slots used so far
+        disp = jnp.zeros((g, tg, e, cap), jnp.float32)
+        combine = jnp.zeros((g, tg, e, cap), jnp.float32)
+        oh0_g = None
+        for r in range(self.top_k):
+            oh = jax.nn.one_hot(top_idx[:, r], e, dtype=jnp.float32)
+            oh_g = oh.reshape(g, tg, e)
+            if r == 0:
+                oh0_g = oh_g
+            pos = jnp.cumsum(oh_g, axis=1) - oh_g + counts  # [G, TG, E]
+            pos_tok = jnp.sum(pos * oh_g, axis=-1)          # [G, TG]
+            keep = pos_tok < cap
+            slot = jax.nn.one_hot(pos_tok.astype(jnp.int32), cap,
+                                  dtype=jnp.float32)        # [G, TG, C]
+            d_r = (oh_g * keep[..., None])[..., None] * slot[:, :, None, :]
+            disp = disp + d_r
+            combine = combine + d_r * top_gates[:, r].reshape(g, tg, 1, 1)
+            counts = counts + jnp.sum(oh_g, axis=1, keepdims=True)
         expert_in = jnp.einsum("gtec,gtd->gecd", disp, xg)  # [G, E, C, D]
 
         # Stacked expert FFNs. Under EP the leading axis is the LOCAL
@@ -130,14 +155,13 @@ class MoEMLP(nn.Module):
             expert_out = jax.vmap(ffn, in_axes=(0, None, None, None, None))(
                 expert_in, w1, b1, w2, b2)            # [G, E, C, D]
 
-        combine = disp * gate.reshape(g, tg)[:, :, None, None]
         y = jnp.einsum("gtec,gecd->gtd", combine,
                        expert_out.astype(jnp.float32))
         y = y.reshape(b, s, d).astype(x.dtype)
 
-        # Switch load-balance loss, per group then averaged: pushes the
-        # router toward uniform expert usage.
-        frac_tokens = jnp.mean(oh_g, axis=1)          # [G, E]
+        # Load-balance loss over FIRST choices (switch eq. 4; GShard uses
+        # the same first-choice fractions), per group then averaged.
+        frac_tokens = jnp.mean(oh0_g, axis=1)         # [G, E]
         frac_probs = jnp.mean(probs.reshape(g, tg, e), axis=1)
         aux = e * jnp.mean(jnp.sum(frac_tokens * frac_probs, axis=-1))
         return y, aux
@@ -152,6 +176,7 @@ class MoEBlock(nn.Module):
     n_groups: int = 1
     ep_axis: Optional[str] = None
     n_local_experts: Optional[int] = None
+    top_k: int = 1
     dtype: Any = jnp.float32
 
     @nn.compact
@@ -169,8 +194,10 @@ class MoEBlock(nn.Module):
         x = x + nn.Dense(d, use_bias=False, dtype=self.dtype)(o)
         y = nn.LayerNorm(dtype=self.dtype)(x)
         m, aux = MoEMLP(self.n_experts, self.d_model, 4 * self.d_model,
-                        self.capacity_factor, self.n_groups, self.ep_axis,
-                        self.n_local_experts, self.dtype, name="moe")(y)
+                        capacity_factor=self.capacity_factor,
+                        n_groups=self.n_groups, ep_axis=self.ep_axis,
+                        n_local_experts=self.n_local_experts,
+                        top_k=self.top_k, dtype=self.dtype, name="moe")(y)
         return x + m, aux
 
 
@@ -189,6 +216,7 @@ class MoETransformerLM(nn.Module):
     max_seq_len: int = 2048
     ep_axis: Optional[str] = None
     n_local_experts: Optional[int] = None
+    top_k: int = 1                    # 1 = switch, 2 = GShard
     # Per-block remat (see models/transformer.py TransformerLM.remat); the
     # recompute replays the block's all_to_alls, which is SPMD-legal.
     remat: bool = False
@@ -206,9 +234,11 @@ class MoETransformerLM(nn.Module):
         aux_total = jnp.float32(0.0)
         for i in range(self.n_layers):
             x, aux = Blk(self.n_heads, self.d_model, self.n_experts,
-                         self.capacity_factor, self.n_groups,
-                         self.ep_axis, self.n_local_experts,
-                         self.dtype, name=f"block_{i}")(x)
+                         capacity_factor=self.capacity_factor,
+                         n_groups=self.n_groups, ep_axis=self.ep_axis,
+                         n_local_experts=self.n_local_experts,
+                         top_k=self.top_k, dtype=self.dtype,
+                         name=f"block_{i}")(x)
             aux_total = aux_total + aux
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
         logits = nn.Dense(self.vocab_size, use_bias=False, dtype=self.dtype,

@@ -43,7 +43,11 @@ def build_lm_oracle(cfg) -> Tuple[Callable, Callable]:
     to_tree = lambda p: p
     if cfg.network == "MoETransformerLM":
         from ps_pytorch_tpu.models.moe import MoETransformerLM
-        model = MoETransformerLM(n_experts=cfg.lm_experts, **geo)
+        # top_k changes the forward (gates, second-expert contributions)
+        # with IDENTICAL param shapes — omitting it here would silently
+        # evaluate a top-2-trained checkpoint with top-1 routing.
+        model = MoETransformerLM(n_experts=cfg.lm_experts,
+                                 top_k=cfg.lm_moe_top_k, **geo)
         apply = lambda p, t: model.apply({"params": p}, t)[0]
     else:
         model = TransformerLM(**geo)

@@ -117,6 +117,7 @@ class LMTrainer:
             from ps_pytorch_tpu.parallel.mesh import make_mesh
             self.mesh = make_mesh(data=n, model=1, devices=devices)
             self.model = MoETransformerLM(n_experts=cfg.lm_experts,
+                                          top_k=cfg.lm_moe_top_k,
                                           ep_axis="data", **lm_kw)
             self.state = create_ep_train_state(
                 self.model, self.tx, self.mesh,
@@ -196,7 +197,8 @@ class LMTrainer:
         # time") and cannot be compared — skip rather than spuriously
         # reject.
         for k in ("lm_vocab", "lm_d_model", "lm_layers", "lm_heads",
-                  "lm_parallelism", "lm_experts", "lm_model_axis"):
+                  "lm_parallelism", "lm_experts", "lm_model_axis",
+                  "lm_moe_top_k"):
             if k == "lm_model_axis" and saved.get(k) == 0:
                 continue
             if k in saved and saved[k] != getattr(self.cfg, k):
