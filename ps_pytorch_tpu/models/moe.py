@@ -7,9 +7,10 @@ the reference has no MoE or EP anywhere, SURVEY §2.5). Design points:
   token to its argmax expert, gate = the raw top probability; ``top_k=2``
   (GShard) sends it to its two best experts with gates renormalized over
   the pair, and first choices claim capacity slots before second choices
-  (rank-priority dispatch — overflow drops second choices first). Tokens
-  beyond ``capacity = ceil(tokens/expert * capacity_factor)`` are dropped
-  (their MLP output is zero — the residual stream carries them unchanged).
+  (rank-priority dispatch — overflow drops second choices first).
+  Assignments beyond ``capacity = ceil(top_k * tokens/expert *
+  capacity_factor)`` are dropped; a token with ALL assignments dropped
+  contributes zero MLP output (the residual stream carries it unchanged).
   Gradients flow through the gate probabilities (top-k selection itself is
   non-differentiable), the standard switch/GShard estimator.
 - **Per-group dispatch** (``n_groups``): capacity accounting runs
@@ -76,7 +77,12 @@ class MoEMLP(nn.Module):
                              f"{self.d_model}")
         g = self.n_groups
         tg = t // g
-        cap = max(math.ceil(tg / e * self.capacity_factor), 1)
+        # Capacity scales with top_k: the router makes top_k*tg assignments
+        # per group, so slots must too — otherwise top-2 at the default
+        # factor would structurally drop ~37% of assignments even under a
+        # perfectly uniform router, quietly degenerating toward an
+        # attenuated top-1.
+        cap = max(math.ceil(self.top_k * tg / e * self.capacity_factor), 1)
 
         if self.top_k not in (1, 2):
             raise ValueError(f"top_k must be 1 or 2, got {self.top_k}")

@@ -112,7 +112,11 @@ class Evaluator:
         geo = lm_geometry(cfg)
         if cfg.network == "MoETransformerLM":
             from ps_pytorch_tpu.models.moe import MoETransformerLM
-            model = MoETransformerLM(n_experts=cfg.lm_experts, **geo)
+            # top_k doesn't change param shapes (this model is init-only,
+            # the eval forward comes from build_lm_oracle), but pass it so
+            # this never silently becomes a top-1 forward if reused.
+            model = MoETransformerLM(n_experts=cfg.lm_experts,
+                                     top_k=cfg.lm_moe_top_k, **geo)
         else:
             model = TransformerLM(**geo)
         init_len = min(cfg.lm_seq_len, 128)

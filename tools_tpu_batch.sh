@@ -22,4 +22,6 @@ timeout 3600 python bench_suite.py --steps 20 --markdown BENCH_SUITE_r03.md \
   && mv BENCH_SUITE_r03.json.new BENCH_SUITE_r03.json
 timeout 1200 python -m ps_pytorch_tpu.tools.accuracy_run --out ACCURACY_r03.json \
     > /tmp/acc_tpu.log 2>&1
+timeout 1200 python -m ps_pytorch_tpu.tools.accuracy_run --lm \
+    --out ACCURACY_LM_r03.json > /tmp/acc_lm_tpu.log 2>&1
 echo TPU_BATCH_DONE
