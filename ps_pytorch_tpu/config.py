@@ -119,6 +119,15 @@ class TrainConfig:
         if self.lm_parallelism not in ("sp", "tp", "pp", "ep"):
             raise ValueError(f"unknown lm_parallelism "
                              f"{self.lm_parallelism!r} (sp | tp | pp | ep)")
+        if self.lm_moe_top_k not in (1, 2):
+            # 1 = switch, 2 = GShard; k>2 would otherwise surface as an
+            # opaque trace-time shape error inside MoEMLP.
+            raise ValueError(f"lm_moe_top_k={self.lm_moe_top_k} (must be 1 "
+                             "[switch] or 2 [GShard top-2])")
+        if self.lm_microbatches < 1:
+            # 0 reaches the pp step as a division by zero mid-trace.
+            raise ValueError(f"lm_microbatches={self.lm_microbatches} "
+                             "(must be >= 1)")
         if self.grad_codec not in ("blosc", "int8"):
             raise ValueError(f"unknown grad_codec {self.grad_codec!r} (blosc | int8)")
         if self.nesterov and (self.momentum <= 0):

@@ -128,6 +128,16 @@ def test_coordinator_validates():
         Coordinator(4, mode="warp")
 
 
+def test_config_validates_lm_fields():
+    """--lm-moe-top-k 3 / --lm-microbatches 0 must fail at config time, not
+    as a trace-time shape error / ZeroDivisionError (round-3 advisor)."""
+    with pytest.raises(ValueError, match="lm_moe_top_k"):
+        TrainConfig(lm_moe_top_k=3)
+    with pytest.raises(ValueError, match="lm_microbatches"):
+        TrainConfig(lm_microbatches=0)
+    TrainConfig(lm_moe_top_k=2, lm_microbatches=1)  # valid corner
+
+
 def test_metrics_schema_roundtrip():
     line = format_line(12, 3, loss=1.234567, acc=0.5, participating=7,
                        step_time=0.123, data_time=0.01)

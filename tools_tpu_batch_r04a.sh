@@ -1,0 +1,15 @@
+#!/bin/bash
+# Round-4 TPU evidence batch, part A: the pieces that need no code changes.
+# Profiler trace first (smallest, highest-value per VERDICT r3 #2), then the
+# headline bench with extras (fused flat-buffer sec/step, int8 GB/s, b=4096).
+cd /root/repo || exit 1
+export JAX_COMPILATION_CACHE_DIR=/root/repo/.jax_cache
+timeout 90 python -c "import jax; d=jax.devices()[0]; assert d.platform=='tpu', d" || exit 7
+set -x
+timeout 900 python -m ps_pytorch_tpu.tools.profile_capture --out ./profile_r04 \
+    > /tmp/profile_digest_r04.json 2>/tmp/profile_err_r04.log
+echo "PROFILE_RC=$?"
+timeout 1500 python bench.py > /tmp/bench_headline_r04.json 2>/tmp/bench_err_r04.log \
+  && cp /tmp/bench_headline_r04.json BENCH_r04_headline.json
+echo "HEADLINE_RC=$?"
+echo TPU_BATCH_A_DONE

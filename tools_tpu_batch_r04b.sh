@@ -1,0 +1,21 @@
+#!/bin/bash
+# Round-4 TPU evidence batch, part B: full suite artifact, HBM memory probe,
+# and the two accuracy-on-chip runs (VERDICT r3 items 1, 4, 7).
+cd /root/repo || exit 1
+export JAX_COMPILATION_CACHE_DIR=/root/repo/.jax_cache
+timeout 90 python -c "import jax; d=jax.devices()[0]; assert d.platform=='tpu', d" || exit 7
+set -x
+timeout 3600 python bench_suite.py --steps 20 --markdown BENCH_SUITE_r04.md \
+    > BENCH_SUITE_r04.json.new 2>/tmp/suite_err_r04.log \
+  && mv BENCH_SUITE_r04.json.new BENCH_SUITE_r04.json
+echo "SUITE_RC=$?"
+timeout 1800 python -m ps_pytorch_tpu.tools.memory_probe --out MEMORY_r04.json \
+    > /tmp/memory_probe_r04.log 2>&1
+echo "MEMORY_RC=$?"
+timeout 1500 python -m ps_pytorch_tpu.tools.accuracy_run --out ACCURACY_r04.json \
+    > /tmp/acc_tpu_r04.log 2>&1
+echo "ACC_RC=$?"
+timeout 1800 python -m ps_pytorch_tpu.tools.accuracy_run --lm \
+    --out ACCURACY_LM_r04.json > /tmp/acc_lm_tpu_r04.log 2>&1
+echo "ACC_LM_RC=$?"
+echo TPU_BATCH_B_DONE
