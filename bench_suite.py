@@ -243,7 +243,8 @@ def bench_async_multislice(name, steps, *, network="ResNet18",
 
 
 def bench_transformer_lm(name, steps, *, batch=8, seq_len=2048, d_model=512,
-                         n_layers=8, n_heads=8, vocab=32000, remat=False):
+                         n_layers=8, n_heads=8, vocab=32000, remat=False,
+                         attention=None):
     """Transformer-LM training throughput (tokens/sec) — the long-context
     surface (SURVEY: SP/ring attention first-class) benched next to the CNN
     rows. Single-axis mesh over all devices; ring attention shards the
@@ -259,9 +260,15 @@ def bench_transformer_lm(name, steps, *, batch=8, seq_len=2048, d_model=512,
     )
 
     devices = jax.devices()
+    # An explicit attention override is sequence-LOCAL (flash/full), and
+    # make_sp_train_step shards the sequence over the mesh — so those rows
+    # pin to ONE device: the row measures the single-chip kernel, on any
+    # topology, instead of silently computing block-diagonal attention.
+    if attention is not None:
+        devices = devices[:1]
     n = len(devices)
     mesh = make_mesh(data=n, devices=devices)
-    impl = "ring" if n > 1 else "full"
+    impl = attention or ("ring" if n > 1 else "full")
     model = TransformerLM(vocab_size=vocab, d_model=d_model,
                           n_layers=n_layers, n_heads=n_heads,
                           max_seq_len=seq_len, attention_impl=impl,
@@ -424,6 +431,17 @@ CONFIGS = {
     # recompute tax in tokens/sec at the same geometry.
     "transformer_lm_2k_remat": lambda steps: bench_transformer_lm(
         "transformer_lm_2k_remat", steps, remat=True),
+    # fused blockwise attention (ops/flash_attention.py) at the same
+    # geometry: the tokens/sec delta vs transformer_lm_2k is the cost of
+    # materializing [S, S] scores, paid by the "full" path.
+    "transformer_lm_2k_flash": lambda steps: bench_transformer_lm(
+        "transformer_lm_2k_flash", steps, attention="flash"),
+    # single-chip long context: S=8192 — the materializing path's backward
+    # residuals alone ([B,H,S,S] per block) exceed HBM here; flash makes
+    # the geometry trainable on one chip at all.
+    "transformer_lm_8k_flash": lambda steps: bench_transformer_lm(
+        "transformer_lm_8k_flash", steps, batch=1, seq_len=8192,
+        attention="flash"),
     "moe_lm_2k": lambda steps: bench_moe_lm("moe_lm_2k", steps),
     "lenet_convergence": lambda steps: bench_time_to_loss(
         "lenet_convergence", "LeNet", "synthetic_mnist", 512,

@@ -89,6 +89,7 @@ class TrainConfig:
     lm_corpus_tokens: int = 1_000_000
     lm_corpus_file: str = ""         # byte-level REAL corpus from any local file ("" = synthetic Markov stream)
     lm_parallelism: str = "sp"       # sp (sequence/ring) | tp (tensor) | pp (pipeline) | ep (MoE experts)
+    lm_attention: str = "auto"       # auto | full | flash (fused Pallas kernel). full/flash are sequence-local: sp over >1 device requires auto (ring)
     lm_model_axis: int = 0           # tp/pp: size of the 'model' mesh axis (0 = all devices)
     lm_microbatches: int = 4         # pp: GPipe microbatch count
     lm_experts: int = 8              # ep: expert count (divisible by device count)
@@ -119,6 +120,9 @@ class TrainConfig:
         if self.lm_parallelism not in ("sp", "tp", "pp", "ep"):
             raise ValueError(f"unknown lm_parallelism "
                              f"{self.lm_parallelism!r} (sp | tp | pp | ep)")
+        if self.lm_attention not in ("auto", "full", "flash"):
+            raise ValueError(f"unknown lm_attention "
+                             f"{self.lm_attention!r} (auto | full | flash)")
         if self.lm_moe_top_k not in (1, 2):
             # 1 = switch, 2 = GShard; k>2 would otherwise surface as an
             # opaque trace-time shape error inside MoEMLP.

@@ -38,6 +38,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from ps_pytorch_tpu.ops.flash_attention import flash_attention
 from ps_pytorch_tpu.parallel.ring import full_attention
 
 
@@ -183,6 +184,7 @@ class MoEBlock(nn.Module):
     ep_axis: Optional[str] = None
     n_local_experts: Optional[int] = None
     top_k: int = 1
+    attention_impl: str = "full"      # "full" | "flash" (seq is never sharded here)
     dtype: Any = jnp.float32
 
     @nn.compact
@@ -195,7 +197,11 @@ class MoEBlock(nn.Module):
         k = nn.Dense(d, use_bias=False, dtype=self.dtype)(y)
         v = nn.Dense(d, use_bias=False, dtype=self.dtype)(y)
         to_heads = lambda t: t.reshape(b, s, h, hd).transpose(0, 2, 1, 3)
-        o = full_attention(to_heads(q), to_heads(k), to_heads(v), causal=True)
+        q, k, v = to_heads(q), to_heads(k), to_heads(v)
+        if self.attention_impl == "flash":
+            o = flash_attention(q, k, v, causal=True)
+        else:
+            o = full_attention(q, k, v, causal=True)
         o = o.transpose(0, 2, 1, 3).reshape(b, s, d)
         x = x + nn.Dense(d, use_bias=False, dtype=self.dtype)(o)
         y = nn.LayerNorm(dtype=self.dtype)(x)
@@ -223,6 +229,7 @@ class MoETransformerLM(nn.Module):
     ep_axis: Optional[str] = None
     n_local_experts: Optional[int] = None
     top_k: int = 1                    # 1 = switch, 2 = GShard
+    attention_impl: str = "full"      # "full" | "flash"
     # Per-block remat (see models/transformer.py TransformerLM.remat); the
     # recompute replays the block's all_to_alls, which is SPMD-legal.
     remat: bool = False
@@ -243,7 +250,9 @@ class MoETransformerLM(nn.Module):
                          capacity_factor=self.capacity_factor,
                          n_groups=self.n_groups, ep_axis=self.ep_axis,
                          n_local_experts=self.n_local_experts,
-                         top_k=self.top_k, dtype=self.dtype,
+                         top_k=self.top_k,
+                         attention_impl=self.attention_impl,
+                         dtype=self.dtype,
                          name=f"block_{i}")(x)
             aux_total = aux_total + aux
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)

@@ -18,6 +18,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from ps_pytorch_tpu.ops.flash_attention import flash_attention
 from ps_pytorch_tpu.parallel.ring import full_attention, ring_attention
 
 
@@ -25,7 +26,7 @@ class Block(nn.Module):
     n_heads: int
     d_model: int
     dtype: Any = jnp.float32
-    attention_impl: str = "full"      # "full" | "ring"
+    attention_impl: str = "full"      # "full" | "ring" | "flash"
     axis_name: str = "data"
 
     @nn.compact
@@ -48,6 +49,10 @@ class Block(nn.Module):
         q, k, v = to_heads(q), to_heads(k), to_heads(v)
         if self.attention_impl == "ring":
             o = ring_attention(q, k, v, self.axis_name, causal=True)
+        elif self.attention_impl == "flash":
+            # Fused blockwise kernel (ops/flash_attention.py): no [S, S]
+            # materialization — the single-chip long-context path.
+            o = flash_attention(q, k, v, causal=True)
         else:
             o = full_attention(q, k, v, causal=True)
         o = o.transpose(0, 2, 1, 3).reshape(b, s, d)

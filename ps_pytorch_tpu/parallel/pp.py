@@ -126,7 +126,8 @@ def reference_forward(model, params, tokens):
     ``model.apply`` and against the PP schedule."""
     x = _embed(model, params, tokens)
     n_layers = len([k for k in params if k.startswith("block_")])
-    block = Block(model.n_heads, model.d_model, model.dtype)
+    block = Block(model.n_heads, model.d_model, model.dtype,
+                  getattr(model, "attention_impl", "full"))
     for i in range(n_layers):
         x = block.apply({"params": params[f"block_{i}"]}, x)
     return _head(model, params, x)
@@ -192,8 +193,10 @@ def make_pp_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
     stage sees the same local tokens (stage 0 embeds, the last stage needs
     the targets). The model must be ``attention_impl='full'``.
     """
-    if getattr(model, "attention_impl", "full") != "full":
-        raise ValueError("PP step requires attention_impl='full'")
+    if getattr(model, "attention_impl", "full") not in ("full", "flash"):
+        # ring needs a sequence mesh axis; full/flash are sequence-local
+        # and run fine inside the per-stage shard_map.
+        raise ValueError("PP step requires attention_impl='full'|'flash'")
     n_stages = mesh.shape[axis_name]
     M = num_microbatches
     stacked = jax.tree.leaves(state.params["blocks"])[0].shape[0]
@@ -204,7 +207,8 @@ def make_pp_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
             f"state was stacked for {stacked} stages but the mesh's "
             f"'{axis_name}' axis has {n_stages} — rebuild the state with "
             f"n_stages={n_stages}")
-    block = Block(model.n_heads, model.d_model, model.dtype)
+    block = Block(model.n_heads, model.d_model, model.dtype,
+                  getattr(model, "attention_impl", "full"))
 
     def pipeline_loss(params, tokens):
         """Runs on ONE stage (inside shard_map): the full T-tick schedule

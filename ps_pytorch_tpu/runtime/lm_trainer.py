@@ -57,10 +57,26 @@ class LMTrainer:
                      n_layers=cfg.lm_layers, n_heads=cfg.lm_heads,
                      max_seq_len=cfg.lm_seq_len)
 
+        # Resolve the attention kernel (--lm-attention). "flash" (the fused
+        # Pallas kernel, ops/flash_attention.py) is sequence-LOCAL: legal
+        # whenever this rank holds the whole sequence (sp on one device,
+        # tp/pp/ep always). sp over >1 device shards the sequence, so the
+        # cross-shard exchange must be ring attention.
+        local_impl = "flash" if cfg.lm_attention == "flash" else "full"
+
         if self.mode == "sp":
             # Sequence sharded over 'data', ring attention across shards.
             self.mesh = Mesh(np.array(devices), ("data",))
-            impl = "ring" if n > 1 else "full"
+            if n > 1:
+                if cfg.lm_attention != "auto":
+                    raise ValueError(
+                        f"lm_attention={cfg.lm_attention!r} is "
+                        f"sequence-local; sp over {n} devices shards the "
+                        "sequence and requires ring attention (use "
+                        "lm_attention=auto)")
+                impl = "ring"
+            else:
+                impl = local_impl
             if cfg.lm_seq_len % n:
                 raise ValueError(f"lm_seq_len {cfg.lm_seq_len} not "
                                  f"divisible by {n} devices (sequence "
@@ -83,7 +99,15 @@ class LMTrainer:
                                  f"lm_model_axis={deg}")
             self.mesh = make_mesh(data=n // deg, model=deg,
                                   devices=devices)
-            self.model = TransformerLM(**lm_kw)
+            if self.mode == "tp" and local_impl != "full":
+                # TP partitions the step with GSPMD; a pallas_call carries
+                # no partitioning rule, so XLA cannot shard the fused
+                # kernel over the head axis. PP runs per-stage inside
+                # shard_map (device-local), where flash is fine.
+                raise ValueError("lm_attention='flash' is not supported "
+                                 "under tp (GSPMD cannot partition the "
+                                 "fused kernel over heads); use full")
+            self.model = TransformerLM(attention_impl=local_impl, **lm_kw)
             if self.mode == "tp":
                 from ps_pytorch_tpu.parallel.tp import (
                     create_tp_train_state, make_tp_train_step,
@@ -118,6 +142,7 @@ class LMTrainer:
             self.mesh = make_mesh(data=n, model=1, devices=devices)
             self.model = MoETransformerLM(n_experts=cfg.lm_experts,
                                           top_k=cfg.lm_moe_top_k,
+                                          attention_impl=local_impl,
                                           ep_axis="data", **lm_kw)
             self.state = create_ep_train_state(
                 self.model, self.tx, self.mesh,

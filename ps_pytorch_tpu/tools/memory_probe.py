@@ -38,7 +38,7 @@ import subprocess
 import sys
 import time
 
-MODES = ("lm_base", "lm_remat", "lm_pp_m1", "lm_pp_m8",
+MODES = ("lm_base", "lm_remat", "lm_flash", "lm_pp_m1", "lm_pp_m8",
          "cnn_base", "cnn_remat", "cnn_zero1")
 
 LM_GEOM = dict(batch=8, seq_len=2048, d_model=512, n_layers=8, n_heads=8,
@@ -73,8 +73,17 @@ def _lm_step(mode):
         from ps_pytorch_tpu.parallel.sp import (
             create_lm_train_state, make_sp_train_step,
         )
-        mesh = make_mesh(data=len(jax.devices()))
-        impl = "ring" if len(jax.devices()) > 1 else "full"
+        # lm_flash: fused blockwise attention (ops/flash_attention.py) — its
+        # backward saves one LSE row per query instead of the [B,H,S,S]
+        # probability tensor the "full" path's backward keeps per block.
+        # Flash is sequence-local, so this mode pins to ONE device (on the
+        # single-chip evidence host every lm_* mode is 1-device anyway).
+        if mode.endswith("flash"):
+            mesh = make_mesh(data=1)
+            impl = "flash"
+        else:
+            mesh = make_mesh(data=len(jax.devices()))
+            impl = "ring" if len(jax.devices()) > 1 else "full"
         model = TransformerLM(vocab_size=g["vocab"], d_model=g["d_model"],
                               n_layers=g["n_layers"], n_heads=g["n_heads"],
                               max_seq_len=g["seq_len"], attention_impl=impl,
