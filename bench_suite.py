@@ -18,6 +18,7 @@ Usage: python bench_suite.py [--configs lenet_mnist_dp,...] [--steps 20]
 
 import argparse
 import json
+import os
 import time
 
 import jax
@@ -451,11 +452,47 @@ CONFIGS = {
 }
 
 
+def _run_isolated(name: str, steps: int, timeout_s: float) -> dict:
+    """One config in a CHILD process with a hard wall-clock bound.
+
+    A wedged device RPC cannot be interrupted in-process (observed
+    2026-07-31: the fused-optimizer row blocked in a tunnel call at 0% CPU
+    for 50 min and took the whole artifact with it); a killed child frees
+    the chip for the next row. The compile cache keeps the per-child
+    restart cost to seconds."""
+    import subprocess
+    import sys as _sys
+    cmd = [_sys.executable, os.path.abspath(__file__), "--configs", name,
+           "--steps", str(steps)]
+    try:
+        res = subprocess.run(cmd, capture_output=True, text=True,
+                             timeout=timeout_s, cwd=os.path.dirname(
+                                 os.path.abspath(__file__)))
+    except subprocess.TimeoutExpired:
+        return {"config": name, "error": f"timeout after {timeout_s:.0f}s "
+                                         "(killed; device freed)"}
+    for line in reversed(res.stdout.splitlines()):
+        try:
+            r = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(r, dict) and r.get("config") == name:
+            return r
+    return {"config": name,
+            "error": f"child rc={res.returncode}: "
+                     f"{(res.stderr or res.stdout)[-200:]}"}
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--configs", default=",".join(CONFIGS))
     p.add_argument("--steps", type=int, default=20)
     p.add_argument("--markdown", default="", help="also write a table here")
+    p.add_argument("--isolate", action="store_true",
+                   help="run each config in its own process with "
+                        "--row-timeout; a hung row is killed and recorded "
+                        "instead of hanging the suite")
+    p.add_argument("--row-timeout", type=float, default=600.0)
     args = p.parse_args(argv)
 
     rows = []
@@ -463,10 +500,13 @@ def main(argv=None) -> int:
         name = name.strip()
         if name not in CONFIGS:
             raise SystemExit(f"unknown config {name!r}; have {sorted(CONFIGS)}")
-        try:
-            r = CONFIGS[name](args.steps)
-        except Exception as e:  # one config failing must not lose the rest
-            r = {"config": name, "error": f"{type(e).__name__}: {e}"[:300]}
+        if args.isolate:
+            r = _run_isolated(name, args.steps, args.row_timeout)
+        else:
+            try:
+                r = CONFIGS[name](args.steps)
+            except Exception as e:  # one config failing must not lose the rest
+                r = {"config": name, "error": f"{type(e).__name__}: {e}"[:300]}
         print(json.dumps(r), flush=True)
         rows.append(r)
 

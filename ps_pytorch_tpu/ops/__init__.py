@@ -10,6 +10,9 @@ CPU mesh while real runs compile to Mosaic.
   of Blosc-packed on the host.
 - ``fused_sgd``: single-pass fused momentum-SGD parameter update (one HBM
   read+write per buffer instead of XLA's multi-kernel chain).
+- ``flash_attention``: blockwise online-softmax causal attention (fwd +
+  dq/dkv bwd) — no [S, S] materialization; the single-chip long-context
+  attention path.
 """
 
 from ps_pytorch_tpu.ops.quantize import (  # noqa: F401
@@ -17,3 +20,4 @@ from ps_pytorch_tpu.ops.quantize import (  # noqa: F401
 )
 from ps_pytorch_tpu.ops.fused_sgd import FusedSGD, fused_sgd_step  # noqa: F401
 from ps_pytorch_tpu.ops.fused_adam import FusedAdam  # noqa: F401
+from ps_pytorch_tpu.ops.flash_attention import flash_attention  # noqa: F401

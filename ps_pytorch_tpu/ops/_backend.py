@@ -1,0 +1,9 @@
+"""Shared backend probe for the Pallas kernels: compile under Mosaic on
+TPU, run in interpreter mode everywhere else (one definition, so the
+kernels can never disagree about when they compile vs interpret)."""
+
+import jax
+
+
+def interpret_default() -> bool:
+    return jax.default_backend() != "tpu"

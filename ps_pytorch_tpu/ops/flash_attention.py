@@ -43,11 +43,9 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ps_pytorch_tpu.ops._backend import interpret_default as _interpret_default
+
 NEG_INF = -1e30
-
-
-def _interpret_default() -> bool:
-    return jax.default_backend() != "tpu"
 
 
 def _pick_block(s: int, requested: int) -> int:

@@ -28,6 +28,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ps_pytorch_tpu.ops._backend import interpret_default as _interpret_default
+
 LANES = 128
 BLOCK_ROWS = 32          # int8 min sublane tile is 32
 BLOCK = BLOCK_ROWS * LANES
@@ -38,10 +40,6 @@ class QuantizedTensor(NamedTuple):
     scales: jax.Array     # float32 [R / BLOCK_ROWS, 1]
     shape: Tuple[int, ...]  # original shape
     size: int             # original element count
-
-
-def _interpret_default() -> bool:
-    return jax.default_backend() != "tpu"
 
 
 def _quant_kernel(s_ref, x_ref, u_ref, v_ref):

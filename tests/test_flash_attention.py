@@ -82,6 +82,38 @@ def test_odd_seq_falls_back():
     np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
 
 
+def test_pp_flash_matches_pp_full():
+    # flash inside the per-stage shard_map: the newly-legal PP path must
+    # equal the full-attention PP step (same init) to fp tolerance.
+    from ps_pytorch_tpu.runtime.lm_trainer import LMTrainer
+    tok = np.random.default_rng(3).integers(0, 256, (8, 256))
+    tokens = jnp.asarray(tok, jnp.int32)
+    losses = {}
+    for impl in ("full", "flash"):
+        tr = LMTrainer(_lm_cfg(lm_parallelism="pp", lm_attention=impl))
+        st = tr.state
+        for i in range(3):
+            st, m = tr.step_fn(st, tokens)
+        losses[impl] = float(m["loss"])
+    np.testing.assert_allclose(losses["flash"], losses["full"],
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_moe_flash_matches_moe_full():
+    from ps_pytorch_tpu.models.moe import MoETransformerLM
+    tok = jnp.asarray(np.random.default_rng(4).integers(0, 64, (2, 128)),
+                      jnp.int32)
+    kw = dict(vocab_size=64, d_model=64, n_layers=2, n_heads=2,
+              n_experts=4, max_seq_len=128)
+    m_full = MoETransformerLM(attention_impl="full", **kw)
+    m_flash = MoETransformerLM(attention_impl="flash", **kw)
+    params = m_full.init(jax.random.key(0), tok)
+    lg_full, aux_full = m_full.apply(params, tok)
+    lg_flash, aux_flash = m_flash.apply(params, tok)
+    np.testing.assert_allclose(lg_flash, lg_full, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(aux_flash, aux_full, rtol=1e-5, atol=1e-6)
+
+
 def _lm_cfg(**kw):
     from ps_pytorch_tpu.config import TrainConfig
     base = dict(dataset="synthetic", network="LeNet", batch_size=8, lr=0.1,

@@ -1,0 +1,30 @@
+#!/bin/bash
+# Round-4 TPU evidence batch, part C: re-run of part B after the 01:06 UTC
+# tunnel wedge (suite row 6 blocked in a device RPC at 0% CPU; probe
+# confirmed a fresh backend couldn't run a matmul either). Differences from
+# part B: the suite runs --isolate (per-row child process + kill timeout,
+# bench_suite.py:_run_isolated) so one wedged RPC costs one row, and the
+# flash-attention rows are included.
+cd /root/repo || exit 1
+export JAX_COMPILATION_CACHE_DIR=/root/repo/.jax_cache
+timeout 90 python -c "
+import jax, jax.numpy as jnp
+assert jax.devices()[0].platform == 'tpu'
+x = jnp.ones((256, 256)); (x @ x).block_until_ready()
+" || exit 7
+set -x
+timeout 5400 python bench_suite.py --steps 20 --isolate --row-timeout 420 \
+    --markdown BENCH_SUITE_r04.md \
+    > BENCH_SUITE_r04.json.new 2>/tmp/suite_err_r04c.log \
+  && mv BENCH_SUITE_r04.json.new BENCH_SUITE_r04.json
+echo "SUITE_RC=$?"
+timeout 1800 python -m ps_pytorch_tpu.tools.memory_probe --out MEMORY_r04.json \
+    --timeout 420 > /tmp/memory_probe_r04.log 2>&1
+echo "MEMORY_RC=$?"
+timeout 1500 python -m ps_pytorch_tpu.tools.accuracy_run --out ACCURACY_r04.json \
+    > /tmp/acc_tpu_r04.log 2>&1
+echo "ACC_RC=$?"
+timeout 1800 python -m ps_pytorch_tpu.tools.accuracy_run --lm \
+    --out ACCURACY_LM_r04.json > /tmp/acc_lm_tpu_r04.log 2>&1
+echo "ACC_LM_RC=$?"
+echo TPU_BATCH_C_DONE
