@@ -24,7 +24,8 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ps_pytorch_tpu.optim.adam import AdamState
-from ps_pytorch_tpu.ops.fused_sgd import LANES, BLOCK_ROWS, _interpret_default, _pad2d
+from ps_pytorch_tpu.ops._backend import interpret_default as _interpret_default
+from ps_pytorch_tpu.ops.fused_sgd import LANES, BLOCK_ROWS, _pad2d
 
 
 def _make_kernel(b1: float, b2: float, eps: float, weight_decay: float,

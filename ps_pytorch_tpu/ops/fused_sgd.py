@@ -25,12 +25,10 @@ from jax.experimental.pallas import tpu as pltpu
 
 from ps_pytorch_tpu.optim.sgd import SGDState
 
+from ps_pytorch_tpu.ops._backend import interpret_default as _interpret_default
+
 LANES = 128
 BLOCK_ROWS = 256          # f32 tile multiple (8); 256*128*4B = 128 KiB/block
-
-
-def _interpret_default() -> bool:
-    return jax.default_backend() != "tpu"
 
 
 def _make_kernel(momentum: float, dampening: float, weight_decay: float,

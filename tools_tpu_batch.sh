@@ -1,27 +1,6 @@
 #!/bin/bash
-# Round-3 TPU evidence batch: runs once the axon tunnel is answering.
-# Regenerates the suite artifact (loader/convergence/async/quantizer rows
-# changed since the first TPU run), captures the profiler trace, redoes the
-# accuracy artifact on the chip, and exercises bench.py's extras path.
-cd /root/repo || exit 1
-# Persistent compile cache: axon windows are short and flaky; a cached
-# executable turns a lost 5-min recompile into a sub-second load when the
-# tunnel comes back.
-export JAX_COMPILATION_CACHE_DIR=/root/repo/.jax_cache
-timeout 90 python -c "import jax; d=jax.devices()[0]; assert d.platform=='tpu', d" || exit 7
-set -x
-# Ordered smallest/highest-value first: if the tunnel dies mid-batch, the
-# trace (~2 min) and the headline+extras (~6 min) land before the full
-# suite (~15 min) and the accuracy run.
-timeout 900 python -m ps_pytorch_tpu.tools.profile_capture --out ./profile_r03 \
-    > /tmp/profile_digest.json 2>/tmp/profile_err.log
-timeout 1200 python bench.py > /tmp/bench_headline.json 2>/tmp/bench_err.log \
-  && cp /tmp/bench_headline.json BENCH_HEADLINE_r03.json
-timeout 3600 python bench_suite.py --steps 20 --markdown BENCH_SUITE_r03.md \
-    > BENCH_SUITE_r03.json.new 2>/tmp/suite_err.log \
-  && mv BENCH_SUITE_r03.json.new BENCH_SUITE_r03.json
-timeout 1200 python -m ps_pytorch_tpu.tools.accuracy_run --out ACCURACY_r03.json \
-    > /tmp/acc_tpu.log 2>&1
-timeout 1200 python -m ps_pytorch_tpu.tools.accuracy_run --lm \
-    --out ACCURACY_LM_r03.json > /tmp/acc_lm_tpu.log 2>&1
-echo TPU_BATCH_DONE
+# Generic TPU evidence batch: what tools_tpu_watch.sh fires when the tunnel
+# answers. Delegates to the newest round batch so the watcher never arms a
+# stale flow (this file's round-3 body ran the suite WITHOUT per-row
+# isolation; a wedged RPC then cost the whole artifact).
+exec bash "$(dirname "$0")/tools_tpu_batch_r04d.sh"
