@@ -3,4 +3,4 @@
 # answers. Delegates to the newest round batch so the watcher never arms a
 # stale flow (this file's round-3 body ran the suite WITHOUT per-row
 # isolation; a wedged RPC then cost the whole artifact).
-exec bash "$(dirname "$0")/tools_tpu_batch_r04d.sh"
+exec bash "$(dirname "$0")/tools_tpu_batch_r04e.sh"

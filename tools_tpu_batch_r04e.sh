@@ -1,0 +1,57 @@
+#!/bin/bash
+# Round-4 TPU evidence batch, part E: the quiet-window re-measure pass.
+#
+# The part-C run (04:47 UTC window) established two facts the artifact must
+# not be left recording as row truth:
+#   1. Every LM/MoE/flash program was COLD (round-3's window closed before
+#      they existed); their first compile through this tunnel takes >420 s,
+#      so each row burned its kill budget and the kill also discarded the
+#      in-flight compile — no cache entry landed.
+#   2. The tunnel's per-dispatch cost was far higher than in the round-3
+#      window, so small-step rows (lenet, resnet18_dp, fused) read 2-20x
+#      slow while large-step rows (b2048/b4096) matched round 3 — and the
+#      in-session pytest runs contended with the host dispatch path.
+#
+# Part E therefore: (a) primes every cold program with NO kill timer so the
+# compile cache fills whatever the compile takes, (b) re-runs the FULL
+# suite isolated in a quiet window (nothing else on the host), (c) redoes
+# memory probe + accuracy, which share the primed programs.
+cd /root/repo || exit 1
+export JAX_COMPILATION_CACHE_DIR=/root/repo/.jax_cache
+timeout 90 python -c "
+import jax, jax.numpy as jnp
+assert jax.devices()[0].platform == 'tpu'
+x = jnp.ones((256, 256)); (x @ x).block_until_ready()
+" || exit 7
+set -x
+# Prime pass: one config at a time, 1 step, a generous 40-min ceiling per
+# config instead of the suite's per-row kill budget (a ceiling is still
+# needed — a truly wedged tunnel must not eat the window — but it is far
+# above any observed cold compile). Timed so PERF.md can record the
+# cold-compile cost.
+for cfg in transformer_lm_2k transformer_lm_2k_remat transformer_lm_2k_flash \
+           transformer_lm_8k_flash moe_lm_2k; do
+  /usr/bin/time -f "PRIME ${cfg} %e s" timeout 2400 \
+    python bench_suite.py --configs "$cfg" --steps 1 \
+    >> /tmp/suite_prime_r04e.log 2>&1
+  echo "PRIME_RC ${cfg} $?"
+done
+# Full suite, warm cache, quiet host. 600 s rows cover the slow-tunnel case.
+timeout 12000 python bench_suite.py --steps 20 --isolate --row-timeout 600 \
+    --markdown BENCH_SUITE_r04.md \
+    > BENCH_SUITE_r04.json.new 2>/tmp/suite_err_r04e.log
+SUITE_RC=$?
+if [ -s BENCH_SUITE_r04.json.new ]; then
+  mv BENCH_SUITE_r04.json.new BENCH_SUITE_r04.json
+fi
+echo "SUITE_RC=$SUITE_RC"
+timeout 3600 python -m ps_pytorch_tpu.tools.memory_probe --out MEMORY_r04.json \
+    --timeout 600 > /tmp/memory_probe_r04.log 2>&1
+echo "MEMORY_RC=$?"
+timeout 1500 python -m ps_pytorch_tpu.tools.accuracy_run --out ACCURACY_r04.json \
+    > /tmp/acc_tpu_r04.log 2>&1
+echo "ACC_RC=$?"
+timeout 2400 python -m ps_pytorch_tpu.tools.accuracy_run --lm \
+    --out ACCURACY_LM_r04.json > /tmp/acc_lm_tpu_r04.log 2>&1
+echo "ACC_LM_RC=$?"
+echo TPU_BATCH_E_DONE
