@@ -76,8 +76,8 @@ def _train_argv(mode: str, n: int, args) -> List[str]:
     return argv
 
 
-def run_cell(mode: str, n: int, args, work: str) -> List[str]:
-    """Launch one (mode, N) run; -> list of per-process log paths."""
+def run_cell(mode: str, n: int, args, work: str):
+    """Launch one (mode, N) run; -> (per-process log paths, cell wall s)."""
     run_dir = os.path.join(work, f"{mode}_n{n}")
     ckpt = os.path.join(run_dir, "ckpt")
     logs = [os.path.join(run_dir, f"proc_{i}.log") for i in range(n)]
@@ -87,12 +87,20 @@ def run_cell(mode: str, n: int, args, work: str) -> List[str]:
     # from silently serving stale cells under a new header.
     stamp_path = os.path.join(run_dir, "cell_params.json")
     stamp = json.dumps({"argv": _train_argv(mode, n, args)}, sort_keys=True)
+    wall_path = os.path.join(run_dir, "cell_wall_s.txt")
     if (os.path.exists(stamp_path)
             and open(stamp_path).read() == stamp
             and all(os.path.exists(l) and "FINAL" in open(l).read()
                     for l in logs)):
         print(f"[scaling] {mode} N={n} cached in {run_dir}", flush=True)
-        return logs
+        wall = float(open(wall_path).read()) if os.path.exists(wall_path) else 0.0
+        return logs, wall
+    if os.path.exists(stamp_path):
+        # A re-run with new params must not leave the old stamp next to new
+        # logs: if this launch fails partway, a later run with the OLD
+        # params would otherwise serve these logs from cache.
+        os.remove(stamp_path)
+    cell_t0 = time.time()
     rc = launch_mod.main([
         "launch", "--run-dir", run_dir, "--simulate", str(n),
         "--devices-per-host", "1", "--port", str(_free_port()),
@@ -108,15 +116,18 @@ def run_cell(mode: str, n: int, args, work: str) -> List[str]:
                 with open(log) as f:
                     tail += f"\n== {log} ==\n" + f.read()[-2000:]
         raise RuntimeError(f"{mode} N={n} launch failed rc={rc}{tail}")
+    wall = time.time() - cell_t0
+    with open(wall_path, "w") as f:
+        f.write(f"{wall:.3f}")
     with open(stamp_path, "w") as f:
         f.write(stamp)
-    return logs
+    return logs, wall
 
 
 def build_table(args, work: str) -> dict:
     sizes = [int(s) for s in args.sizes.split(",")]
     modes = args.modes.split(",")
-    t0 = time.time()
+    cells_wall = 0.0
     result: dict = {
         "artifact": "scaling",
         "network": args.network, "dataset": args.dataset,
@@ -134,12 +145,16 @@ def build_table(args, work: str) -> dict:
         runs: Dict[str, List[str]] = {}
         for n in sizes:
             print(f"[scaling] {mode} N={n} ...", flush=True)
-            runs[str(n)] = run_cell(mode, n, args, work)
+            runs[str(n)], cell_wall = run_cell(mode, n, args, work)
+            cells_wall += cell_wall
         rows = analyze_mod.analyze(runs, baseline=str(min(sizes)),
                                    skip_first=args.skip_first)
         result["modes"][mode] = rows
         print(analyze_mod.to_markdown(rows), flush=True)
-    result["wall_s"] = round(time.time() - t0, 1)
+    # Sum of per-cell launch walls (persisted next to each cell), so a
+    # resume-cached rebuild still reports what the measurements cost rather
+    # than the near-zero harvesting time.
+    result["wall_s"] = round(cells_wall, 1)
     return result
 
 
