@@ -143,17 +143,19 @@ def main(argv=None) -> int:
     if args.child:
         return child_main(args.child)
 
+    # Validate the WHOLE list before spawning anything: each child costs
+    # minutes, and a typo in mode 5 must not surface only after four
+    # children ran. (Empty entries would fall through --child to the
+    # parent branch in the child and recursively run the whole suite; a
+    # typo would dispatch on prefix/suffix and silently measure the BASE
+    # config under the wrong label — r4 review.)
+    modes = [m.strip() for m in args.modes.split(",") if m.strip()]
+    bad = [m for m in modes if m not in MODES]
+    if bad:
+        p.error(f"unknown mode(s) {bad}; valid: {', '.join(MODES)}")
+
     rows = []
-    for mode in args.modes.split(","):
-        mode = mode.strip()
-        if not mode:
-            # An empty --child would fall through to the parent branch in
-            # the child and recursively run the whole suite.
-            continue
-        if mode not in MODES:
-            # A typo would otherwise dispatch on prefix/suffix and silently
-            # measure the BASE config under the wrong label (r4 review).
-            p.error(f"unknown mode {mode!r}; valid: {', '.join(MODES)}")
+    for mode in modes:
         cmd = [sys.executable, "-m", "ps_pytorch_tpu.tools.memory_probe",
                "--child", mode]
         try:

@@ -93,8 +93,13 @@ def run_cell(mode: str, n: int, args, work: str):
             and all(os.path.exists(l) and "FINAL" in open(l).read()
                     for l in logs)):
         print(f"[scaling] {mode} N={n} cached in {run_dir}", flush=True)
-        wall = float(open(wall_path).read()) if os.path.exists(wall_path) else 0.0
-        return logs, wall
+        if not os.path.exists(wall_path):
+            # Pre-wall-tracking cell: its cost is unknown, not zero — the
+            # caller marks the artifact's wall_s incomplete.
+            print(f"[scaling] {mode} N={n} has no cell_wall_s.txt; "
+                  "wall_s will be marked incomplete", flush=True)
+            return logs, None
+        return logs, float(open(wall_path).read())
     if os.path.exists(stamp_path):
         # A re-run with new params must not leave the old stamp next to new
         # logs: if this launch fails partway, a later run with the OLD
@@ -146,7 +151,10 @@ def build_table(args, work: str) -> dict:
         for n in sizes:
             print(f"[scaling] {mode} N={n} ...", flush=True)
             runs[str(n)], cell_wall = run_cell(mode, n, args, work)
-            cells_wall += cell_wall
+            if cell_wall is None:
+                result["wall_s_incomplete"] = True
+            else:
+                cells_wall += cell_wall
         rows = analyze_mod.analyze(runs, baseline=str(min(sizes)),
                                    skip_first=args.skip_first)
         result["modes"][mode] = rows
