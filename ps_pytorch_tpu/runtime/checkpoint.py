@@ -71,8 +71,17 @@ def save_checkpoint(train_dir: str, step: int, state: Any,
     return final
 
 
-def load_checkpoint(train_dir: str, step: int, target: Any) -> Tuple[Any, dict, str]:
-    """-> (state_like_target, meta, config_json)."""
+def load_checkpoint(train_dir: str, step: int, target: Any,
+                    migrate=None) -> Tuple[Any, dict, str]:
+    """-> (state_like_target, meta, config_json).
+
+    ``migrate``: optional ``raw_state_dict -> (state_dict, n_changed)``
+    applied when the stored tree's STRUCTURE no longer matches ``target``
+    (a pre-format-change checkpoint); the restore is retried on the
+    migrated tree iff it changed anything. Structure mismatches are how
+    flax surfaces layout changes (from_state_dict raises on key
+    differences), so this is the one hook point old checkpoints funnel
+    through."""
     path = checkpoint_path(train_dir, step)
     with open(os.path.join(path, "meta.json")) as f:
         meta = json.load(f)
@@ -83,7 +92,18 @@ def load_checkpoint(train_dir: str, step: int, target: Any) -> Tuple[Any, dict, 
         blob = w_decompress(blob).tobytes()
     with open(os.path.join(path, "config.json")) as f:
         config_json = f.read()
-    state = serialization.from_bytes(target, blob)
+    raw = serialization.msgpack_restore(blob)
+    try:
+        state = serialization.from_state_dict(target, raw)
+    except Exception:
+        if migrate is None:
+            raise
+        migrated, n_changed = migrate(raw)
+        if not n_changed:
+            raise
+        state = serialization.from_state_dict(target, migrated)
+        print(f"[ckpt] migrated legacy checkpoint layout at step {step} "
+              f"({n_changed} tree nodes rewritten)")
     return state, meta, config_json
 
 

@@ -30,7 +30,9 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ps_pytorch_tpu.config import TrainConfig
 from ps_pytorch_tpu.data.text import TokenLoader
-from ps_pytorch_tpu.models.transformer import TransformerLM
+from ps_pytorch_tpu.models.transformer import (
+    TransformerLM, migrate_packed_qkv,
+)
 from ps_pytorch_tpu.optim import build_schedule
 from ps_pytorch_tpu.optim.sgd import sgd
 from ps_pytorch_tpu.parallel import dist
@@ -195,19 +197,21 @@ class LMTrainer:
         # device_get raises on non-addressable shards.
         template = dist.all_replicated(self.mesh, self.state)
         try:
+            # migrate: checkpoints written before the q/k/v projection
+            # split (packed [d,3d] Dense_0, Block Dense_0..3) are rewritten
+            # to the current layout in-memory — exact column split, see
+            # models/transformer.py:migrate_packed_qkv.
             state, meta, config_json = ckpt.load_checkpoint(
-                self.cfg.train_dir, step, template)
+                self.cfg.train_dir, step, template,
+                migrate=migrate_packed_qkv)
         except Exception as e:
             # Most likely a non-LM (CNN) checkpoint sharing the default
             # ./train_dir — surface that instead of a msgpack key error.
             raise ValueError(
                 f"could not restore step {step} from {self.cfg.train_dir} "
                 f"into the LM state (a train.py checkpoint in the same "
-                f"train_dir? use a separate --train-dir or --no-resume; "
-                f"checkpoints written before the q/k/v projection split "
-                f"— Block params Dense_0..3 with a packed [d,3d] qkv "
-                f"kernel — predate the current tree and are not "
-                f"restorable): {type(e).__name__}: {e}") from e
+                f"train_dir? use a separate --train-dir or "
+                f"--no-resume): {type(e).__name__}: {e}") from e
         # A CNN checkpoint in the same train_dir would fail deep inside
         # deserialization; check the saved config's model geometry first
         # and fail with an actionable message instead.
