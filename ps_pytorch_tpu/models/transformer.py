@@ -28,6 +28,40 @@ class Block(nn.Module):
     dtype: Any = jnp.float32
     attention_impl: str = "full"      # "full" | "ring" | "flash"
     axis_name: str = "data"
+    # Autoregressive decoding (models/generate.py): one token per call,
+    # k/v appended to a fixed-length cache ("cache" collection) so each
+    # step attends over the whole prefix without recomputing it. Static
+    # cache length keeps the decode step a single compiled program under
+    # lax.scan. Param tree is IDENTICAL to training (same six Dense calls
+    # in the same order), so any checkpoint decodes as-is.
+    decode: bool = False
+    decode_cache_len: int = 0
+
+    def _cached_attention(self, q, k, v):
+        """Single-query attention over the running k/v cache.
+
+        q/k/v: [B, h, 1, hd]. Mirrors full_attention's numerics (scale,
+        -inf mask, softmax) so decode logits match the training forward
+        bit-for-bit up to reduction order (tests/test_generate.py pins
+        the parity)."""
+        b, h, _, hd = q.shape
+        length = self.decode_cache_len
+        ck = self.variable("cache", "k", jnp.zeros, (b, h, length, hd),
+                           q.dtype)
+        cv = self.variable("cache", "v", jnp.zeros, (b, h, length, hd),
+                           q.dtype)
+        idx = self.variable("cache", "idx",
+                            lambda: jnp.zeros((), jnp.int32))
+        i = idx.value
+        ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, 0, i, 0))
+        cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, 0, i, 0))
+        idx.value = i + 1
+        scale = hd ** -0.5
+        s = jnp.einsum("bhqd,bhkd->bhqk", q * scale, ck.value)
+        ok = (jnp.arange(length) <= i)[None, None, None, :]
+        s = jnp.where(ok, s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bhqk,bhkd->bhqd", p, cv.value)
 
     @nn.compact
     def __call__(self, x):
@@ -47,7 +81,9 @@ class Block(nn.Module):
         v = nn.Dense(d, use_bias=False, dtype=self.dtype)(y)
         to_heads = lambda t: t.reshape(b, s, h, hd).transpose(0, 2, 1, 3)
         q, k, v = to_heads(q), to_heads(k), to_heads(v)
-        if self.attention_impl == "ring":
+        if self.decode:
+            o = self._cached_attention(q, k, v)
+        elif self.attention_impl == "ring":
             o = ring_attention(q, k, v, self.axis_name, causal=True)
         elif self.attention_impl == "flash":
             # Fused blockwise kernel (ops/flash_attention.py): no [S, S]
@@ -79,6 +115,10 @@ class TransformerLM(nn.Module):
     # holds all residuals at once anyway. Param tree is unchanged, so
     # remat can be toggled on an existing checkpoint.
     remat: bool = False
+    # Autoregressive decode mode (see Block.decode): one token per call,
+    # fixed-length k/v caches. Same param tree as training.
+    decode: bool = False
+    decode_cache_len: int = 0
 
     @nn.compact
     def __call__(self, tokens, positions: Optional[jax.Array] = None,
@@ -91,10 +131,13 @@ class TransformerLM(nn.Module):
                      name="tok_embed")(tokens)
         x = x + nn.Embed(self.max_seq_len, self.d_model, dtype=self.dtype,
                          name="pos_embed")(positions)[None]
-        Blk = nn.remat(Block) if self.remat else Block
+        Blk = nn.remat(Block) if (self.remat and not self.decode) else Block
         for i in range(self.n_layers):
             x = Blk(self.n_heads, self.d_model, self.dtype,
-                    self.attention_impl, self.axis_name, name=f"block_{i}")(x)
+                    self.attention_impl, self.axis_name,
+                    decode=self.decode,
+                    decode_cache_len=self.decode_cache_len,
+                    name=f"block_{i}")(x)
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
         logits = nn.Dense(self.vocab_size, use_bias=False, dtype=self.dtype,
                           name="lm_head")(x)

@@ -87,9 +87,6 @@ class Evaluator:
         mode exists to avoid, so it is only used when ring sharding is
         impossible (one device, or indivisible sequence)."""
         from ps_pytorch_tpu.data.text import TokenLoader, lm_streams
-        from ps_pytorch_tpu.optim import build_schedule
-        from ps_pytorch_tpu.optim.sgd import sgd
-        from ps_pytorch_tpu.parallel.dp import TrainState
         from ps_pytorch_tpu.runtime.lm_eval import build_lm_oracle, lm_geometry
 
         self._lm_sp_eval = None
@@ -105,32 +102,11 @@ class Evaluator:
                                  **lm_geometry(cfg))
             self._lm_sp_eval = (make_sp_eval_fn(ring, mesh), mesh)
         loss_fn, to_tree = build_lm_oracle(cfg)
-
         # Template state for deserialization: same model family + same
-        # optimizer construction as LMTrainer, so the tree matches.
-        from ps_pytorch_tpu.models.transformer import TransformerLM
-        geo = lm_geometry(cfg)
-        if cfg.network == "MoETransformerLM":
-            from ps_pytorch_tpu.models.moe import MoETransformerLM
-            # top_k doesn't change param shapes (this model is init-only,
-            # the eval forward comes from build_lm_oracle), but pass it so
-            # this never silently becomes a top-1 forward if reused.
-            model = MoETransformerLM(n_experts=cfg.lm_experts,
-                                     top_k=cfg.lm_moe_top_k, **geo)
-        else:
-            model = TransformerLM(**geo)
-        init_len = min(cfg.lm_seq_len, 128)
-        params = model.init(jax.random.key(0),
-                            jnp.zeros((1, init_len), jnp.int32),
-                            positions=jnp.arange(init_len))["params"]
-        if cfg.lm_parallelism == "pp":
-            from ps_pytorch_tpu.parallel.pp import stack_stage_params
-            params = stack_stage_params(params, cfg.lm_model_axis)
-        tx = sgd(lr=build_schedule(cfg), momentum=cfg.momentum,
-                 weight_decay=cfg.weight_decay, nesterov=cfg.nesterov)
-        self.template = TrainState(step=jnp.zeros((), jnp.int32),
-                                   params=params, opt_state=tx.init(params),
-                                   batch_stats={})
+        # optimizer construction as LMTrainer, so the tree matches
+        # (shared with generate.py via lm_eval.build_lm_template).
+        from ps_pytorch_tpu.runtime.lm_eval import build_lm_template
+        self.template = build_lm_template(cfg)
         _, val = lm_streams(cfg)
         self._lm_val = TokenLoader(val, cfg.batch_size, cfg.lm_seq_len,
                                    seed=0, shuffle=False)

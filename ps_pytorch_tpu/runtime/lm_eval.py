@@ -68,3 +68,36 @@ def build_lm_oracle(cfg) -> Tuple[Callable, Callable]:
             logits[:, :-1], tokens[:, 1:]).mean()
 
     return loss_fn, to_tree
+
+
+def build_lm_template(cfg):
+    """Template TrainState for deserializing an LM checkpoint outside the
+    trainer (polling evaluator, generate.py CLI): same model family and
+    optimizer construction as LMTrainer, so the tree structure matches
+    byte-for-byte. Layout normalization (pp stage-stacking -> plain tree)
+    stays with ``build_lm_oracle``'s to_tree — one source of truth."""
+    import jax.numpy as jnp
+
+    from ps_pytorch_tpu.models.transformer import TransformerLM
+    from ps_pytorch_tpu.optim import build_schedule
+    from ps_pytorch_tpu.optim.sgd import sgd
+    from ps_pytorch_tpu.parallel.dp import TrainState
+
+    geo = lm_geometry(cfg)
+    if cfg.network == "MoETransformerLM":
+        from ps_pytorch_tpu.models.moe import MoETransformerLM
+        model = MoETransformerLM(n_experts=cfg.lm_experts,
+                                 top_k=cfg.lm_moe_top_k, **geo)
+    else:
+        model = TransformerLM(**geo)
+    init_len = min(cfg.lm_seq_len, 128)
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, init_len), jnp.int32),
+                        positions=jnp.arange(init_len))["params"]
+    if cfg.lm_parallelism == "pp":
+        from ps_pytorch_tpu.parallel.pp import stack_stage_params
+        params = stack_stage_params(params, cfg.lm_model_axis)
+    tx = sgd(lr=build_schedule(cfg), momentum=cfg.momentum,
+             weight_decay=cfg.weight_decay, nesterov=cfg.nesterov)
+    return TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                      opt_state=tx.init(params), batch_stats={})
