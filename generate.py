@@ -55,10 +55,7 @@ def main(argv=None) -> int:
         p.error(f"no model_step_<k> checkpoints in {args.train_dir}")
     with open(f"{ckpt.checkpoint_path(args.train_dir, step)}/config.json") as f:
         cfg = TrainConfig.from_json(f.read())
-    if cfg.network == "MoETransformerLM":
-        p.error("generation supports TransformerLM checkpoints (the MoE "
-                "forward has no decode path yet)")
-
+    moe = cfg.network == "MoETransformerLM"
     template = build_lm_template(cfg)
     _, to_tree = build_lm_oracle(cfg)
     state, _, _ = ckpt.load_checkpoint(args.train_dir, step, template,
@@ -84,7 +81,9 @@ def main(argv=None) -> int:
                    d_model=cfg.lm_d_model, n_layers=cfg.lm_layers,
                    n_heads=cfg.lm_heads, max_seq_len=cfg.lm_seq_len,
                    temperature=args.temperature, top_k=args.top_k,
-                   seed=args.seed)
+                   seed=args.seed,
+                   n_experts=cfg.lm_experts if moe else 0,
+                   moe_top_k=cfg.lm_moe_top_k)
     text = bytes(np.asarray(out[0], np.uint8)).decode("utf-8", "replace")
     print(json.dumps({"step": step, "prompt_bytes": len(prompt_bytes),
                       "generated_bytes": args.n_new}))

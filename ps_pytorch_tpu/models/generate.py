@@ -39,16 +39,22 @@ def _sample(logits, key, temperature: float, top_k: int):
 
 @partial(jax.jit, static_argnames=(
     "n_new", "vocab", "d_model", "n_layers", "n_heads", "max_seq_len",
-    "temperature", "top_k", "dtype"))
+    "temperature", "top_k", "dtype", "n_experts", "moe_top_k",
+    "moe_capacity_factor"))
 def generate(params, prompt, *, n_new: int, vocab: int, d_model: int,
              n_layers: int, n_heads: int, max_seq_len: int,
              temperature: float = 1.0, top_k: int = 0, seed: int = 0,
-             dtype: Any = jnp.float32):
+             dtype: Any = jnp.float32, n_experts: int = 0,
+             moe_top_k: int = 1, moe_capacity_factor: float = 1.25):
     """Generate ``n_new`` tokens after ``prompt`` with a k/v cache.
 
     ``max_seq_len`` is the CHECKPOINT's positional-table length (the
     ``--lm-seq-len`` the model was trained with) — the learned positional
-    embedding has exactly that many rows, so it is not a free choice."""
+    embedding has exactly that many rows, so it is not a free choice.
+    ``n_experts > 0`` decodes a MoETransformerLM checkpoint. MoE decode
+    dispatches each token as its own capacity group (MoEBlock sets
+    n_groups = B in decode mode), so expert assignments are never dropped
+    and batch rows decode independently."""
     b, s0 = prompt.shape
     if s0 == 0:
         raise ValueError("prompt must be non-empty (the first sampled "
@@ -58,17 +64,27 @@ def generate(params, prompt, *, n_new: int, vocab: int, d_model: int,
         raise ValueError(f"prompt ({s0}) + n_new ({n_new}) exceeds "
                          f"max_seq_len ({max_seq_len}) — the positional "
                          f"table and cache are that long")
-    model = TransformerLM(vocab_size=vocab, d_model=d_model,
-                          n_layers=n_layers, n_heads=n_heads,
-                          max_seq_len=max_seq_len, dtype=dtype,
-                          attention_impl="full", decode=True,
-                          decode_cache_len=total)
+    if n_experts:
+        from ps_pytorch_tpu.models.moe import MoETransformerLM
+        model = MoETransformerLM(vocab_size=vocab, d_model=d_model,
+                                 n_layers=n_layers, n_heads=n_heads,
+                                 n_experts=n_experts, top_k=moe_top_k,
+                                 capacity_factor=moe_capacity_factor,
+                                 max_seq_len=max_seq_len, dtype=dtype,
+                                 decode=True, decode_cache_len=total)
+    else:
+        model = TransformerLM(vocab_size=vocab, d_model=d_model,
+                              n_layers=n_layers, n_heads=n_heads,
+                              max_seq_len=max_seq_len, dtype=dtype,
+                              attention_impl="full", decode=True,
+                              decode_cache_len=total)
 
     def step(cache, tok_pos):
         tok, pos = tok_pos       # tok [B], pos scalar
-        logits, vars_ = model.apply(
+        out, vars_ = model.apply(
             {"params": params, "cache": cache}, tok[:, None],
             positions=pos[None], mutable=["cache"])
+        logits = out[0] if n_experts else out   # MoE returns (logits, aux)
         return vars_["cache"], logits[:, 0]
 
     # Materialize the cache structure with one throwaway step (flax

@@ -38,6 +38,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from ps_pytorch_tpu.models.transformer import cached_attention
 from ps_pytorch_tpu.ops.flash_attention import flash_attention
 from ps_pytorch_tpu.parallel.ring import full_attention
 
@@ -186,6 +187,17 @@ class MoEBlock(nn.Module):
     top_k: int = 1
     attention_impl: str = "full"      # "full" | "flash" (seq is never sharded here)
     dtype: Any = jnp.float32
+    # Autoregressive decode (models/generate.py): cached attention, one
+    # token per call. The MoE dispatch runs with n_groups = B (each
+    # decoded token its own capacity group): top_k experts per token are
+    # distinct, each claims slot 0 of its expert within its own group, so
+    # decode NEVER drops an assignment and batch rows decode
+    # independently — with one shared group, two rows routing to the same
+    # expert at cap=1 would silently zero one row's MLP output. The
+    # batched training forward CAN drop (capacity overflow); decode ==
+    # training forward exactly when that forward dropped nothing.
+    decode: bool = False
+    decode_cache_len: int = 0
 
     @nn.compact
     def __call__(self, x):
@@ -198,7 +210,9 @@ class MoEBlock(nn.Module):
         v = nn.Dense(d, use_bias=False, dtype=self.dtype)(y)
         to_heads = lambda t: t.reshape(b, s, h, hd).transpose(0, 2, 1, 3)
         q, k, v = to_heads(q), to_heads(k), to_heads(v)
-        if self.attention_impl == "flash":
+        if self.decode:
+            o = cached_attention(self, q, k, v, self.decode_cache_len)
+        elif self.attention_impl == "flash":
             o = flash_attention(q, k, v, causal=True)
         else:
             o = full_attention(q, k, v, causal=True)
@@ -207,7 +221,8 @@ class MoEBlock(nn.Module):
         y = nn.LayerNorm(dtype=self.dtype)(x)
         m, aux = MoEMLP(self.n_experts, self.d_model, 4 * self.d_model,
                         capacity_factor=self.capacity_factor,
-                        n_groups=self.n_groups, ep_axis=self.ep_axis,
+                        n_groups=(b * s) if self.decode else self.n_groups,
+                        ep_axis=self.ep_axis,
                         n_local_experts=self.n_local_experts,
                         top_k=self.top_k, dtype=self.dtype, name="moe")(y)
         return x + m, aux
@@ -234,6 +249,9 @@ class MoETransformerLM(nn.Module):
     # recompute replays the block's all_to_alls, which is SPMD-legal.
     remat: bool = False
     dtype: Any = jnp.float32
+    # Autoregressive decode (see MoEBlock.decode).
+    decode: bool = False
+    decode_cache_len: int = 0
 
     @nn.compact
     def __call__(self, tokens, positions: Optional[jax.Array] = None):
@@ -243,7 +261,8 @@ class MoETransformerLM(nn.Module):
                      name="tok_embed")(tokens)
         x = x + nn.Embed(self.max_seq_len, self.d_model, dtype=self.dtype,
                          name="pos_embed")(positions)[None]
-        Blk = nn.remat(MoEBlock) if self.remat else MoEBlock
+        Blk = nn.remat(MoEBlock) if (self.remat and not self.decode) \
+            else MoEBlock
         aux_total = jnp.float32(0.0)
         for i in range(self.n_layers):
             x, aux = Blk(self.n_heads, self.d_model, self.n_experts,
@@ -252,7 +271,8 @@ class MoETransformerLM(nn.Module):
                          n_local_experts=self.n_local_experts,
                          top_k=self.top_k,
                          attention_impl=self.attention_impl,
-                         dtype=self.dtype,
+                         dtype=self.dtype, decode=self.decode,
+                         decode_cache_len=self.decode_cache_len,
                          name=f"block_{i}")(x)
             aux_total = aux_total + aux
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)

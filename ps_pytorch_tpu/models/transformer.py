@@ -22,6 +22,30 @@ from ps_pytorch_tpu.ops.flash_attention import flash_attention
 from ps_pytorch_tpu.parallel.ring import full_attention, ring_attention
 
 
+def cached_attention(mod: nn.Module, q, k, v, length: int):
+    """Single-query attention over a running k/v cache, shared by the
+    dense Block and MoEBlock decode paths (the cache variables live in the
+    CALLING module's "cache" collection).
+
+    q/k/v: [B, h, 1, hd]. Mirrors full_attention's numerics (scale, -inf
+    mask, softmax) so decode logits match the training forward bit-for-bit
+    up to reduction order (tests/test_generate.py pins the parity)."""
+    b, h, _, hd = q.shape
+    ck = mod.variable("cache", "k", jnp.zeros, (b, h, length, hd), q.dtype)
+    cv = mod.variable("cache", "v", jnp.zeros, (b, h, length, hd), q.dtype)
+    idx = mod.variable("cache", "idx", lambda: jnp.zeros((), jnp.int32))
+    i = idx.value
+    ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, 0, i, 0))
+    cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, 0, i, 0))
+    idx.value = i + 1
+    scale = hd ** -0.5
+    s = jnp.einsum("bhqd,bhkd->bhqk", q * scale, ck.value)
+    ok = (jnp.arange(length) <= i)[None, None, None, :]
+    s = jnp.where(ok, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, cv.value)
+
+
 class Block(nn.Module):
     n_heads: int
     d_model: int
@@ -38,30 +62,7 @@ class Block(nn.Module):
     decode_cache_len: int = 0
 
     def _cached_attention(self, q, k, v):
-        """Single-query attention over the running k/v cache.
-
-        q/k/v: [B, h, 1, hd]. Mirrors full_attention's numerics (scale,
-        -inf mask, softmax) so decode logits match the training forward
-        bit-for-bit up to reduction order (tests/test_generate.py pins
-        the parity)."""
-        b, h, _, hd = q.shape
-        length = self.decode_cache_len
-        ck = self.variable("cache", "k", jnp.zeros, (b, h, length, hd),
-                           q.dtype)
-        cv = self.variable("cache", "v", jnp.zeros, (b, h, length, hd),
-                           q.dtype)
-        idx = self.variable("cache", "idx",
-                            lambda: jnp.zeros((), jnp.int32))
-        i = idx.value
-        ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, 0, i, 0))
-        cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, 0, i, 0))
-        idx.value = i + 1
-        scale = hd ** -0.5
-        s = jnp.einsum("bhqd,bhkd->bhqk", q * scale, ck.value)
-        ok = (jnp.arange(length) <= i)[None, None, None, :]
-        s = jnp.where(ok, s, -jnp.inf)
-        p = jax.nn.softmax(s, axis=-1)
-        return jnp.einsum("bhqk,bhkd->bhqd", p, cv.value)
+        return cached_attention(self, q, k, v, self.decode_cache_len)
 
     @nn.compact
     def __call__(self, x):

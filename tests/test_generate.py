@@ -81,3 +81,59 @@ def test_overflow_rejected():
     with pytest.raises(ValueError, match="exceeds"):
         generate(params, jnp.zeros((1, 10), jnp.int32), n_new=10,
                  max_seq_len=16, temperature=0.0, **GEO)
+
+
+def test_moe_greedy_decode_matches_full_forward():
+    """MoE decode parity: decode dispatches each token as its own group
+    (never drops), so it equals the batched training forward exactly WHEN
+    that forward dropped nothing. capacity_factor=8 makes ORACLE-side
+    drops structurally impossible at this geometry (cap >= total tokens
+    even if the router sent everything to one expert), so the parity is
+    exact by construction, not by seed luck — at the default 1.25 this
+    same test diverged in the last tokens of one batch row (a real
+    capacity drop in the batched forward)."""
+    from ps_pytorch_tpu.models.moe import MoETransformerLM
+
+    geo = dict(vocab_size=37, d_model=32, n_layers=2, n_heads=4,
+               n_experts=4, top_k=2, max_seq_len=32, capacity_factor=8.0)
+    m = MoETransformerLM(**geo)
+    params = m.init(jax.random.key(5), jnp.zeros((1, 6), jnp.int32),
+                    positions=jnp.arange(6))["params"]
+    prompt = jnp.asarray(
+        np.random.default_rng(6).integers(0, 37, (2, 5)), jnp.int32)
+
+    toks = np.asarray(prompt)
+    for _ in range(6):
+        s = toks.shape[1]
+        logits, _ = m.apply({"params": params}, jnp.asarray(toks),
+                            positions=jnp.arange(s))
+        nxt = np.argmax(np.asarray(logits[:, -1]), axis=-1)
+        toks = np.concatenate([toks, nxt[:, None].astype(np.int32)], axis=1)
+
+    out = generate(params, prompt, n_new=6, vocab=37, d_model=32,
+                   n_layers=2, n_heads=4, max_seq_len=32, temperature=0.0,
+                   n_experts=4, moe_top_k=2, moe_capacity_factor=8.0)
+    np.testing.assert_array_equal(np.asarray(out), toks)
+
+
+def test_moe_batch_rows_decode_independently_at_tight_capacity():
+    """The enforced mechanism behind the no-drop invariant: at the DEFAULT
+    capacity factor, batched MoE decode must equal each row decoded alone
+    — with one shared dispatch group this fails (two rows routing to the
+    same expert at cap=1 zero one row's MLP output)."""
+    from ps_pytorch_tpu.models.moe import MoETransformerLM
+
+    geo = dict(vocab_size=37, d_model=32, n_layers=2, n_heads=4,
+               n_experts=4, top_k=1, max_seq_len=32)
+    m = MoETransformerLM(**geo)
+    params = m.init(jax.random.key(8), jnp.zeros((1, 6), jnp.int32),
+                    positions=jnp.arange(6))["params"]
+    p = jnp.asarray(np.random.default_rng(9).integers(0, 37, (3, 5)),
+                    jnp.int32)
+    kw = dict(n_new=6, vocab=37, d_model=32, n_layers=2, n_heads=4,
+              max_seq_len=32, temperature=0.0, n_experts=4, moe_top_k=1)
+    both = generate(params, p, **kw)
+    for i in range(3):
+        solo = generate(params, p[i:i + 1], **kw)
+        np.testing.assert_array_equal(np.asarray(both[i]),
+                                      np.asarray(solo[0]))
