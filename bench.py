@@ -143,6 +143,16 @@ def child_main(args) -> int:
             out["int8_quantize_ms"] = round(dt * 1e3, 3)
             out["int8_quantize_gbps"] = round(n * 4 / dt / 1e9, 1)
             out["int8_shrink"] = round(n * 4 / quantized_nbytes(q), 2)
+            # Blocking per-call latency next to the pipelined average: the
+            # two diverge by the tunnel's per-dispatch cost (PERF.md §4 —
+            # r3's "8.7 vs 413 GB/s" was exactly this split unmeasured).
+            t0 = time.perf_counter()
+            for i in range(5):
+                q = quantize_int8(xq, keys[i % 32])
+                jax.block_until_ready(q.values)
+            dtb = (time.perf_counter() - t0) / 5
+            out["int8_blocking_ms"] = round(dtb * 1e3, 3)
+            out["int8_blocking_gbps"] = round(n * 4 / dtb / 1e9, 1)
         except Exception as e:
             out["int8_error"] = f"{type(e).__name__}: {e}"[:200]
         try:
@@ -158,6 +168,20 @@ def child_main(args) -> int:
                     flops_per_image * big / big_sps / (peak * n_dev), 4)
         except Exception as e:
             out["bigbatch_error"] = f"{type(e).__name__}: {e}"[:200]
+        # LM throughput rides the artifact LAST: its first compile through
+        # a slow tunnel can exceed the attempt budget, so reprint the
+        # CNN extras first — the parent salvages the last metric line.
+        print(json.dumps(out), flush=True)
+        try:
+            from bench_suite import bench_transformer_lm
+            lm = bench_transformer_lm("bench_extra_lm", steps=5)
+            out["lm_tokens_per_sec"] = lm["tokens_per_sec"]
+            out["lm_sec_per_step"] = lm["sec_per_step"]
+            out["lm_geometry"] = {k: lm[k] for k in
+                                  ("batch", "seq_len", "d_model",
+                                   "n_layers")}
+        except Exception as e:
+            out["lm_error"] = f"{type(e).__name__}: {e}"[:200]
 
     print(json.dumps(out))
     return 0
@@ -212,6 +236,13 @@ def _run_attempt(label: str, env_overrides: dict, timeout_s: float,
         if d is not None:
             return d, None
         return None, f"{label}: exited 0 but no JSON result line"
+    # A child CRASH after the headline printed (e.g. the LM extra's large
+    # compile killing the process) must not discard the measurement any
+    # more than a hang does — salvage the last flushed metric line.
+    d = _last_metric_line(proc.stdout)
+    if d is not None:
+        d["extras_crashed"] = f"rc={proc.returncode}"
+        return d, None
     tail = (proc.stderr or proc.stdout or "").strip().splitlines()[-3:]
     return None, f"{label}: rc={proc.returncode}: " + " | ".join(tail)[-400:]
 
