@@ -126,9 +126,10 @@ def bench_input_pipeline(name, dataset, per_device_batch, steps):
     VERDICT r1 item 4; reference capability: multiprocess loader,
     my_data_loader.py:37-75)."""
     from ps_pytorch_tpu.config import TrainConfig
+    from ps_pytorch_tpu.data.augment import (
+        CROP_STACKS, input_norm_for, norm_constants_for,
+    )
     from ps_pytorch_tpu.data.datasets import DataLoader, load_arrays
-
-    from ps_pytorch_tpu.data.augment import input_norm_for
 
     n_dev = len(jax.devices())
     batch = per_device_batch * n_dev
@@ -145,14 +146,20 @@ def bench_input_pipeline(name, dataset, per_device_batch, steps):
         n_img += len(xb)
     dt = time.perf_counter() - t0
     ips = n_img / dt
+    stack = ("pad4+crop+flip" if dataset in CROP_STACKS else "shuffle+batch")
+    if not dev_norm and norm_constants_for(dataset) is not None:
+        stack += "+normalize"
     return {"config": name, "dataset": dataset, "global_batch": batch,
             # The loader is HOST-side by design: its throughput is valid
             # whatever backend jax resolved to; the ratio row pairs it with
             # the chip row's platform.
             "platform": "host",
             "loader_images_per_sec": round(ips, 1),
-            "augment": "pad4+crop+flip" +
-                       ("" if dev_norm else "+normalize"),
+            # Bandwidth of the SHIPPED batches (xb), not the storage array:
+            # uint8-stored data host-normalized to float32 ships 4x the
+            # storage bytes.
+            "bytes_per_sec_mb": round(ips * xb.nbytes / len(xb) / 1e6, 1),
+            "augment": stack,
             "device_normalize": dev_norm}
 
 
@@ -449,6 +456,12 @@ CONFIGS = {
         target_loss=0.8),
     "input_pipeline": lambda steps: bench_input_pipeline(
         "input_pipeline", "synthetic_cifar10", 1024, steps),
+    # ImageNet geometry (224 px, 602 KB/image): no augment stack (the
+    # reference had none for ImageNet), so this measures the
+    # shuffle+batch+ship path against resnet50_imagenet's chip demand —
+    # at 1.2k img/s the chip pulls ~0.7 GB/s from this loader.
+    "input_pipeline_imagenet": lambda steps: bench_input_pipeline(
+        "input_pipeline_imagenet", "synthetic_imagenet", 32, steps),
 }
 
 
@@ -484,6 +497,12 @@ def _run_isolated(name: str, steps: int, timeout_s: float) -> dict:
 
 
 def main(argv=None) -> int:
+    # Honor PS_TPU_PLATFORM=cpu like the trainer CLIs (parallel/dist.py):
+    # the TPU plugin's sitecustomize overrides JAX_PLATFORMS at the config
+    # level, and a wedged tunnel otherwise hangs even host-only rows
+    # (input_pipeline*) at backend init.
+    from ps_pytorch_tpu.parallel.dist import _apply_platform_overrides
+    _apply_platform_overrides()
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--configs", default=",".join(CONFIGS))
     p.add_argument("--steps", type=int, default=20)
@@ -513,15 +532,19 @@ def main(argv=None) -> int:
     # Loader-vs-chip: when both the headline training config and the loader
     # bench ran, print their ratio — >= 2.0 means the input pipeline can
     # feed the chip with headroom (VERDICT r1 item 4's done-bar).
-    chip = next((r for r in rows if r.get("config") == "resnet18_cifar10_dp"
-                 and "images_per_sec" in r), None)
-    loader = next((r for r in rows if r.get("config") == "input_pipeline"
-                   and "loader_images_per_sec" in r), None)
-    if chip and loader:
-        ratio = loader["loader_images_per_sec"] / chip["images_per_sec"]
-        print(json.dumps({"config": "loader_vs_chip_demand",
-                          "ratio": round(ratio, 2),
-                          "ok": ratio >= 2.0}), flush=True)
+    for chip_cfg, loader_cfg, label in (
+            ("resnet18_cifar10_dp", "input_pipeline",
+             "loader_vs_chip_demand"),
+            ("resnet50_imagenet", "input_pipeline_imagenet",
+             "loader_vs_chip_demand_imagenet")):
+        chip = next((r for r in rows if r.get("config") == chip_cfg
+                     and "images_per_sec" in r), None)
+        loader = next((r for r in rows if r.get("config") == loader_cfg
+                       and "loader_images_per_sec" in r), None)
+        if chip and loader:
+            ratio = loader["loader_images_per_sec"] / chip["images_per_sec"]
+            print(json.dumps({"config": label, "ratio": round(ratio, 2),
+                              "ok": ratio >= 2.0}), flush=True)
 
     if args.markdown:
         lines = ["| config | devices | global batch | sec/step | images/sec | vs baseline |",
