@@ -138,7 +138,8 @@ def bench_input_pipeline(name, dataset, per_device_batch, steps):
     x, y = load_arrays(cfg.dataset, cfg.data_dir, train=True, seed=0)
     loader = DataLoader(x, y, batch, cfg.dataset, train=True, seed=0,
                         device_normalize=dev_norm)
-    loader.next_batch()          # warm the prefetch thread
+    xb, _ = loader.next_batch()  # warm the prefetch thread (and bind xb
+    #                              for the bytes row even at --steps 0)
     t0 = time.perf_counter()
     n_img = 0
     for _ in range(steps):
@@ -459,7 +460,7 @@ CONFIGS = {
     # ImageNet geometry (224 px, 602 KB/image): no augment stack (the
     # reference had none for ImageNet), so this measures the
     # shuffle+batch+ship path against resnet50_imagenet's chip demand —
-    # at 1.2k img/s the chip pulls ~0.7 GB/s from this loader.
+    # 1,666 img/s in BENCH_SUITE_r03.json, ~1.0 GB/s from this loader.
     "input_pipeline_imagenet": lambda steps: bench_input_pipeline(
         "input_pipeline_imagenet", "synthetic_imagenet", 32, steps),
 }

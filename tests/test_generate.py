@@ -137,3 +137,31 @@ def test_moe_batch_rows_decode_independently_at_tight_capacity():
         solo = generate(params, p[i:i + 1], **kw)
         np.testing.assert_array_equal(np.asarray(both[i]),
                                       np.asarray(solo[0]))
+
+
+def test_generate_from_pp_checkpoint(tmp_path):
+    """The CLI restore path (build_lm_template + build_lm_oracle.to_tree +
+    generate) must decode a PIPELINE-trained checkpoint: pp stores
+    stage-stacked blocks, which to_tree unstacks to the plain tree the
+    decode model applies. (Attention impl is not a param-tree property,
+    so ring/flash-trained checkpoints are structurally the sp case.)"""
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+    import generate as generate_cli
+
+    from ps_pytorch_tpu.config import TrainConfig
+    from ps_pytorch_tpu.runtime.lm_trainer import LMTrainer
+
+    cfg = TrainConfig(batch_size=8, lr=0.1, momentum=0.9, max_steps=4,
+                      eval_freq=4, log_every=10, lm_seq_len=128,
+                      lm_d_model=64, lm_layers=4, lm_heads=4,
+                      lm_corpus_tokens=120_000, lm_parallelism="pp",
+                      lm_model_axis=4, lm_microbatches=2,
+                      train_dir=str(tmp_path / "pp"))
+    LMTrainer(cfg).train()
+    rc = generate_cli.main(["--train-dir", str(tmp_path / "pp"),
+                            "--prompt", "ab", "--n-new", "8",
+                            "--temperature", "0"])
+    assert rc == 0
