@@ -266,6 +266,39 @@ def _tpu_alive(env: dict, timeout_s: float = 90.0) -> bool:
         return False
 
 
+def _last_tpu_artifact():
+    """Compact pointer to the newest committed on-chip headline, attached
+    to fallback/failed rows: a dead-tunnel round still tells the reader
+    what the chip measured when it was last reachable — LABELED as a prior
+    artifact, never substituted for the current value."""
+    import glob
+    import re
+    newest = None        # (round_number, name, doc) — newest ROUND wins,
+    #                      never the best value (that would cherry-pick a
+    #                      past peak over the latest real measurement)
+    for path in glob.glob(os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "BENCH_r*_headline.json")):
+        m = re.search(r"BENCH_r(\d+)_headline", path)
+        if not m:
+            continue
+        try:
+            with open(path) as f:
+                d = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if (d.get("platform") == "tpu"
+                and isinstance(d.get("value"), (int, float))
+                and (newest is None or int(m.group(1)) > newest[0])):
+            newest = (int(m.group(1)), os.path.basename(path), d)
+    if newest is None:
+        return None
+    _, name, d = newest
+    return {"artifact": name, "value": d.get("value"),
+            "vs_baseline": d.get("vs_baseline"),
+            "device_kind": d.get("device_kind")}
+
+
 def parent_main(args) -> int:
     """Attempt ladder: TPU (probe-gated, retry with backoff) then labeled
     CPU fallback. Always prints one JSON line; always exits 0 so the
@@ -326,6 +359,7 @@ def parent_main(args) -> int:
             result["attempts"] = attempts
             if label == "cpu-fallback":
                 result["fallback"] = "cpu"
+                result["last_measured_tpu"] = _last_tpu_artifact()
             print(json.dumps(result))
             return 0
         attempts.append(err)
@@ -342,6 +376,7 @@ def parent_main(args) -> int:
         "metric": METRIC, "value": 0.0, "unit": "images/sec",
         "vs_baseline": 0.0, "error": "all attempts failed",
         "attempts": attempts,
+        "last_measured_tpu": _last_tpu_artifact(),
     }))
     return 0
 
