@@ -31,6 +31,10 @@ def _sample(logits, key, temperature: float, top_k: int):
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     logits = logits / temperature
+    # Clamp to the vocab: a top_k past V (e.g. the CLI default 40 against a
+    # tiny-vocab checkpoint) would index off the sorted axis with an opaque
+    # trace-time error; top_k >= V is simply "no truncation".
+    top_k = min(top_k, logits.shape[-1])
     if top_k > 0:
         kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
         logits = jnp.where(logits < kth, -jnp.inf, logits)

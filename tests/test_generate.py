@@ -76,6 +76,17 @@ def test_sampling_seeded_and_shaped():
     assert int(jnp.max(a)) < GEO["vocab"] and int(jnp.min(a)) >= 0
 
 
+def test_top_k_past_vocab_is_no_truncation():
+    """top_k >= V must clamp to V (CLI default --top-k 40 vs small-vocab
+    checkpoints), and behave exactly like untruncated sampling."""
+    params = _params()
+    prompt = jnp.zeros((1, 4), jnp.int32)
+    kw = dict(n_new=6, max_seq_len=64, temperature=0.9, seed=5, **GEO)
+    big = generate(params, prompt, top_k=GEO["vocab"] + 39, **kw)
+    exact = generate(params, prompt, top_k=GEO["vocab"], **kw)
+    np.testing.assert_array_equal(np.asarray(big), np.asarray(exact))
+
+
 def test_overflow_rejected():
     params = _params(max_seq_len=16)
     with pytest.raises(ValueError, match="exceeds"):

@@ -1,0 +1,9 @@
+#!/bin/bash
+# Shared tunnel-liveness probe: a REAL compiled matmul under a hard
+# timeout. jax.devices() alone is not a probe — backend init can succeed
+# while compile/execute hangs (observed 2026-07-30). Exit 0 = tunnel up.
+exec timeout "${1:-90}" python -c "
+import jax, jax.numpy as jnp
+assert jax.devices()[0].platform == 'tpu'
+x = jnp.ones((256, 256)); (x @ x).block_until_ready()
+"

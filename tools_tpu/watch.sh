@@ -1,0 +1,22 @@
+#!/bin/bash
+# Tunnel watcher: probe the axon TPU with a real compiled op every ~145 s;
+# on the first live window, fire the canonical batch once.
+#
+# Usage: bash tools_tpu/watch.sh [N_PROBES] [ROUND]
+#   N_PROBES  default 120 (~4.8 h of watching)
+#   ROUND     forwarded to batch.sh (default r05)
+#
+# The probe must be a compiled op, not jax.devices() — backend init can
+# succeed while compile hangs (observed 2026-07-30).
+cd /root/repo || exit 1
+export JAX_COMPILATION_CACHE_DIR=/root/repo/.jax_cache
+for i in $(seq 1 "${1:-120}"); do
+  if bash tools_tpu/probe.sh 2>/dev/null; then
+    echo "tunnel up (probe $i) $(date -u +%H:%M:%S)"
+    bash tools_tpu/batch.sh "${2:-r05}"
+    exit $?
+  fi
+  sleep 55
+done
+echo TUNNEL_NEVER_ANSWERED
+exit 9
