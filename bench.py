@@ -194,7 +194,7 @@ def _run_attempt(label: str, env_overrides: dict, timeout_s: float,
     -> (parsed JSON dict or None, error string or None)."""
     env = dict(os.environ)
     # Persistent compile cache: if an earlier session already compiled
-    # these programs (tools_tpu_batch.sh populates the same dir), the
+    # these programs (tools_tpu/batch.sh populates the same dir), the
     # child's first step loads the executable instead of re-lowering —
     # the difference between fitting in a flaky tunnel window and not.
     env.setdefault("JAX_COMPILATION_CACHE_DIR",

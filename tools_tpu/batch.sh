@@ -21,7 +21,7 @@ ROUND="${1:-r05}"
 cd /root/repo || exit 1
 export JAX_COMPILATION_CACHE_DIR=/root/repo/.jax_cache
 
-probe() { bash "$(dirname "$0")/probe.sh"; }
+probe() { bash tools_tpu/probe.sh; }   # repo-relative: we cd'd above
 
 commit_artifacts() {  # $1 = message; commits only if something changed
   # One `git add` per path: a single multi-path add exits 128 and stages
