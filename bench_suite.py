@@ -411,6 +411,73 @@ def bench_lm_decode(name, steps, *, batch=1, prompt_len=128, n_new=128,
                 batch * (1 + n_new) / t_full, 1)}
 
 
+def bench_pallas_conv_ab(name, steps, *, batch=1024, hw=32, c=64):
+    """A/B: Pallas 3x3 conv prototype vs lax.conv on the trace's hot
+    geometry (PERF.md §7: 32x32/64-ch blocks HBM-bound at ~486 GB/s, the
+    step's one remaining lever, bounded ≈ +17%). Times the fwd kernel and
+    the grad-input twin; ``accepted`` is decided HERE, by ratio, not in
+    prose (VERDICT r4 next #4: 'a number either way')."""
+    from ps_pytorch_tpu.ops.pallas_conv import conv3x3, conv3x3_input_grad
+
+    platform = jax.devices()[0].platform
+    if platform != "tpu":
+        batch, steps = 64, min(steps, 3)    # interpret-mode smoke only
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(batch, hw, hw, c)), jnp.bfloat16)
+    w = jnp.asarray(rng.normal(size=(3, 3, c, c)) * 0.1, jnp.bfloat16)
+
+    def xla_conv(x, w):
+        return jax.lax.conv_general_dilated(
+            x, w, (1, 1), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            preferred_element_type=jnp.float32).astype(x.dtype)
+
+    xla_conv = jax.jit(xla_conv)
+
+    def timed(fn, *args):
+        fn(*args).block_until_ready()       # compile
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            r = fn(*args)
+        r.block_until_ready()
+        return (time.perf_counter() - t0) / steps
+
+    # XLA's grad-input baseline is its OWN transpose(jvp) program (the
+    # trace's actual backward hotspot), not the forward conv re-timed.
+    # vjp through the bf16 conv exactly as the models build it (flax leaves
+    # preferred_element_type unset; an explicit f32 accumulate makes the
+    # transpose rule feed an f32 cotangent to a bf16-weight conv, which
+    # lax rejects).
+    def bf16_conv(xx):
+        return jax.lax.conv_general_dilated(
+            xx, w, (1, 1), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+    _, xla_vjp = jax.vjp(bf16_conv, x)
+    xla_bwd = jax.jit(lambda gg: xla_vjp(gg)[0])
+
+    t_xla = timed(xla_conv, x, w)
+    t_pl = timed(conv3x3, x, w)
+    t_xla_bwd = timed(xla_bwd, x)       # x reused as the cotangent
+    t_pl_bwd = timed(conv3x3_input_grad, x, w)
+    flops = 2 * batch * hw * hw * c * c * 9
+    ratio = t_xla / t_pl
+    ratio_bwd = t_xla_bwd / t_pl_bwd
+    on_tpu = platform == "tpu"
+    return {"config": name, "platform": platform, "batch": batch,
+            "hw": hw, "channels": c,
+            "xla_ms": round(t_xla * 1e3, 3),
+            "pallas_ms": round(t_pl * 1e3, 3),
+            "xla_grad_input_ms": round(t_xla_bwd * 1e3, 3),
+            "pallas_grad_input_ms": round(t_pl_bwd * 1e3, 3),
+            "xla_tflops": round(flops / t_xla / 1e12, 1),
+            "pallas_tflops": round(flops / t_pl / 1e12, 1),
+            "speedup_vs_xla": round(ratio, 3),
+            "speedup_vs_xla_bwd": round(ratio_bwd, 3),
+            "accepted_fwd": bool(on_tpu and ratio > 1.05),
+            "accepted_bwd": bool(on_tpu and ratio_bwd > 1.05),
+            "accepted": bool(on_tpu and (ratio > 1.05 or ratio_bwd > 1.05))}
+
+
 def bench_time_to_loss(name, network, dataset, batch, target_loss,
                        max_steps=400):
     """Convergence probe: wall-clock to reach target training loss on a
@@ -507,6 +574,8 @@ CONFIGS = {
         "lm_decode_b1", min(steps, 5)),
     "lm_decode_b32": lambda steps: bench_lm_decode(
         "lm_decode_b32", min(steps, 5), batch=32),
+    "pallas_conv_ab": lambda steps: bench_pallas_conv_ab(
+        "pallas_conv_ab", steps),
     "lenet_convergence": lambda steps: bench_time_to_loss(
         "lenet_convergence", "LeNet", "synthetic_mnist", 512,
         target_loss=0.8),

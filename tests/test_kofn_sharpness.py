@@ -21,9 +21,16 @@ REPO = pathlib.Path(__file__).resolve().parent.parent
 
 @pytest.mark.slow
 def test_kofn_drops_exactly_the_slow_host(tmp_path):
-    """3 hosts x 2 replicas, K=4, host 2 injected 0.4 s/step slower: the
-    leader's published mask must end as [1,1,1,1,0,0] — host 2's replicas
-    (4, 5) excluded, everyone else kept."""
+    """3 hosts x 2 replicas, K=4, host 0 injected 0.4 s/step slower: the
+    leader's published mask must end as [0,0,1,1,1,1] — host 0's replicas
+    excluded, everyone else kept.
+
+    The slow host MUST be host 0: before any durations propagate, the
+    duration-free stable-sort tiebreak keeps the LOWEST replica indices
+    (mask [1,1,1,1,0,0]), so slowing host 2 would expect exactly the
+    default mask and pass even with duration reporting broken. Slowing
+    host 0 forces the decision to flip away from the tiebreak — only real
+    duration propagation over the KV can produce [0,0,1,1,1,1]."""
     from ps_pytorch_tpu.tools import launch
 
     run_dir = tmp_path / "run"
@@ -37,7 +44,7 @@ def test_kofn_drops_exactly_the_slow_host(tmp_path):
         "--dataset", "synthetic_mnist", "--network", "LeNet",
         "--batch-size", "96", "--lr", "0.05", "--momentum", "0.9",
         "--mode", "kofn", "--num-aggregate", "4",
-        "--inject-step-delay", "0.4", "--inject-delay-process", "2",
+        "--inject-step-delay", "0.4", "--inject-delay-process", "0",
         "--epochs", "0", "--max-steps", "25", "--eval-freq", "25",
         "--train-dir", str(ckpt), "--log-every", "5",
     ])
@@ -51,8 +58,9 @@ def test_kofn_drops_exactly_the_slow_host(tmp_path):
     assert masks, dump
     # Converged decision: once host durations have propagated over the KV,
     # the slow host's replicas — and ONLY those — are dropped. Earlier
-    # masks may differ (duration-free tiebreak keeps lowest indices).
-    assert masks[-1] == "[1, 1, 1, 1, 0, 0]", (masks, dump)
+    # masks may differ (the duration-free tiebreak keeps lowest indices,
+    # i.e. starts at the OPPOSITE decision [1,1,1,1,0,0]).
+    assert masks[-1] == "[0, 0, 1, 1, 1, 1]", (masks, dump)
     # The in-graph masked psum saw the same decision: participating
     # replicas reported in the step metrics settle at K=4.
     part_lines = [ln for ln in leader.splitlines() if "participating" in ln]

@@ -35,8 +35,8 @@ exec sh -c "$*"
 """
 
 WORKER = """import os, sys, time
-print("worker rank", os.environ.get("PS_TPU_PROCESS_ID", "?"), "nproc",
-      os.environ.get("PS_TPU_NUM_PROCESSES", "?"), flush=True)
+print("worker rank", os.environ.get({pid_var!r}, "?"), "nproc",
+      os.environ.get({nproc_var!r}, "?"), flush=True)
 mode = sys.argv[1] if len(sys.argv) > 1 else "quick"
 if mode == "hang":
     for i in range(600):
@@ -61,8 +61,9 @@ def rig(tmp_path):
     ssh.write_text(SSH_SHIM)
     ssh.chmod(ssh.stat().st_mode | stat.S_IEXEC)
     (tmp_path / "hosts").write_text("127.0.0.1\n127.0.0.1\n127.0.0.1\n")
+    coord, nproc, pid = _env_names()
     worker = tmp_path / "worker.py"
-    worker.write_text(WORKER)
+    worker.write_text(WORKER.format(pid_var=pid, nproc_var=nproc))
     env = dict(os.environ)
     env["PATH"] = f"{shim_dir}:{env['PATH']}"
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
@@ -93,7 +94,6 @@ def test_ssh_fleet_launch_wait_final(rig):
     assert len(calls) == 3
     assert all(c.startswith("SSH_CALL -o BatchMode=yes 127.0.0.1") for c in calls)
     # Each remote log carries the REMOTE python's pid and the env contract.
-    coord, nproc, pid = _env_names()
     for rank in range(3):
         log = (tmp_path / "run" / f"proc_{rank}.log").read_text()
         assert "REMOTE_PID " in log

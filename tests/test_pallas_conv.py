@@ -1,0 +1,60 @@
+"""Correctness pins for the Pallas 3x3 conv prototype (ops/pallas_conv.py)
+against lax.conv_general_dilated — interpret mode on the CPU mesh, same
+semantics the chip compiles (ops/_backend.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ps_pytorch_tpu.ops.pallas_conv import conv3x3, conv3x3_input_grad
+
+
+def _xla_conv(x, w):
+    return jax.lax.conv_general_dilated(
+        x, w, window_strides=(1, 1), padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.float32).astype(x.dtype)
+
+
+@pytest.mark.parametrize("shape,cout", [
+    ((4, 8, 8, 16), 16),       # tiny, fast
+    ((2, 32, 32, 64), 64),     # the trace's hot geometry (small batch)
+    ((3, 8, 8, 16), 8),        # N not divisible by block_n; Cin != Cout
+])
+def test_matches_xla_f32(shape, cout):
+    kx, kw = jax.random.split(jax.random.key(0))
+    x = jax.random.normal(kx, shape, jnp.float32)
+    w = jax.random.normal(kw, (3, 3, shape[-1], cout), jnp.float32) * 0.1
+    np.testing.assert_allclose(np.asarray(conv3x3(x, w)),
+                               np.asarray(_xla_conv(x, w)),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_matches_xla_bf16():
+    kx, kw = jax.random.split(jax.random.key(1))
+    x = jax.random.normal(kx, (2, 16, 16, 32), jnp.bfloat16)
+    w = jax.random.normal(kw, (3, 3, 32, 32), jnp.bfloat16) * 0.1
+    # Both sides accumulate f32 and cast once; identical tap order is not
+    # guaranteed, so compare at bf16 resolution.
+    np.testing.assert_allclose(
+        np.asarray(conv3x3(x, w), np.float32),
+        np.asarray(_xla_conv(x, w), np.float32), rtol=2e-2, atol=2e-2)
+
+
+def test_input_grad_matches_autodiff():
+    kx, kw, kg = jax.random.split(jax.random.key(2), 3)
+    x = jax.random.normal(kx, (2, 8, 8, 16), jnp.float32)
+    w = jax.random.normal(kw, (3, 3, 16, 16), jnp.float32) * 0.1
+    g = jax.random.normal(kg, (2, 8, 8, 16), jnp.float32)
+    _, vjp = jax.vjp(lambda xx: _xla_conv(xx, w), x)
+    np.testing.assert_allclose(np.asarray(conv3x3_input_grad(g, w)),
+                               np.asarray(vjp(g)[0]), rtol=1e-5, atol=1e-5)
+
+
+def test_rejects_bad_shapes():
+    x = jnp.zeros((2, 8, 8, 16))
+    with pytest.raises(ValueError, match="3,3"):
+        conv3x3(x, jnp.zeros((5, 5, 16, 16)))
+    with pytest.raises(ValueError, match="3,3"):
+        conv3x3(x, jnp.zeros((3, 3, 8, 16)))
