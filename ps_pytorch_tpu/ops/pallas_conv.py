@@ -40,30 +40,44 @@ from jax.experimental.pallas import tpu as pltpu
 from ps_pytorch_tpu.ops._backend import interpret_default as _interpret_default
 
 
-def _conv_kernel(x_ref, w_ref, o_ref, acc, *, h, w, c_out):
+def _conv_kernel(x_ref, w_ref, o_ref, acc, *, h, w, c_out, variant):
     """One batch tile: x_ref [Bt, H+2, W+2, C], w_ref [9C, Co] (tap-major),
-    o_ref [Bt, H, W, Co], acc f32 [Bt*H*W, Co]."""
+    o_ref [Bt, H, W, Co], acc f32 [Bt*H*W, Co].
+
+    Two MXU schedules, chosen by the on-chip A/B (the better one is not
+    predictable from first principles through the tunnel):
+    - ``taps9``: 9 accumulating dots, K = C each (K=64 quarter-fills the
+      128x128 MXU at the hot geometry, but no patch materialization);
+    - ``im2col``: one dot, K = 9C (K=576 keeps the systolic K dim ~90%
+      fed; pays a [rows, 9C] lane-concat relayout in VMEM).
+    """
     bt = o_ref.shape[0]
     c_in = x_ref.shape[-1]
-    acc[:] = jnp.zeros_like(acc)
-    for dy in range(3):
-        for dx in range(3):
-            xs = x_ref[:, dy:dy + h, dx:dx + w, :].reshape(bt * h * w, c_in)
-            tap = w_ref[(dy * 3 + dx) * c_in:(dy * 3 + dx + 1) * c_in, :]
+    taps = [x_ref[:, dy:dy + h, dx:dx + w, :].reshape(bt * h * w, c_in)
+            for dy in range(3) for dx in range(3)]
+    if variant == "im2col":
+        patches = jnp.concatenate(taps, axis=1)          # [rows, 9C]
+        acc[:] = jax.lax.dot_general(
+            patches, w_ref[:], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+    else:
+        acc[:] = jnp.zeros_like(acc)
+        for t in range(9):
             acc[:] += jax.lax.dot_general(
-                xs, tap, (((1,), (0,)), ((), ())),
+                taps[t], w_ref[t * c_in:(t + 1) * c_in, :],
+                (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
     o_ref[:] = acc[:].reshape(bt, h, w, c_out).astype(o_ref.dtype)
 
 
-@partial(jax.jit, static_argnames=("block_n", "interpret"))
-def _conv3x3(x, w, block_n, interpret):
+@partial(jax.jit, static_argnames=("block_n", "interpret", "variant"))
+def _conv3x3(x, w, block_n, interpret, variant):
     n, h, wd, c = x.shape
     c_out = w.shape[-1]
     xp = jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)))
     w2 = w.reshape(9 * c, c_out)
     return pl.pallas_call(
-        partial(_conv_kernel, h=h, w=wd, c_out=c_out),
+        partial(_conv_kernel, h=h, w=wd, c_out=c_out, variant=variant),
         grid=(n // block_n,),
         in_specs=[
             pl.BlockSpec((block_n, h + 2, wd + 2, c), lambda i: (i, 0, 0, 0)),
@@ -77,29 +91,37 @@ def _conv3x3(x, w, block_n, interpret):
     )(xp, w2)
 
 
-def conv3x3(x, w, *, block_n: int = 8,
+def conv3x3(x, w, *, block_n: int = 8, variant: str = "taps9",
             interpret: Optional[bool] = None) -> jax.Array:
     """NHWC 3x3 stride-1 SAME conv. x [N,H,W,C] @ w [3,3,C,Co] -> [N,H,W,Co].
 
-    ``block_n`` is the batch tile per grid step (auto-shrunk to divide N).
-    f32 accumulation regardless of dtype — matches
+    ``block_n`` is the batch tile per grid step (auto-shrunk to divide N);
+    ``variant`` picks the MXU schedule (see _conv_kernel). f32 accumulation
+    regardless of dtype — matches
     ``lax.conv_general_dilated(..., preferred_element_type=f32)``.
     """
     if x.ndim != 4 or w.shape[:2] != (3, 3) or w.shape[2] != x.shape[-1]:
         raise ValueError(f"need x [N,H,W,C] and w [3,3,C,Co]; got "
                          f"{x.shape} / {w.shape}")
+    if variant not in ("taps9", "im2col"):
+        raise ValueError(f"unknown variant {variant!r}")
     if interpret is None:
         interpret = _interpret_default()
     n = x.shape[0]
     while n % block_n:
         block_n //= 2
-    return _conv3x3(x, w, max(block_n, 1), interpret)
+    # im2col materializes [Bt*H*W, 9C] patches in VMEM — halve the batch
+    # tile to keep the block under the double-buffering budget.
+    if variant == "im2col":
+        block_n = max(block_n // 2, 1)
+    return _conv3x3(x, w, max(block_n, 1), interpret, variant)
 
 
-def conv3x3_input_grad(g, w, *, block_n: int = 8,
+def conv3x3_input_grad(g, w, *, block_n: int = 8, variant: str = "taps9",
                        interpret: Optional[bool] = None) -> jax.Array:
     """Gradient w.r.t. the conv INPUT — the trace's ``transpose(jvp)``
     backward twin. For stride-1 SAME, d/dx is itself a 3x3 SAME conv of the
     cotangent with spatially-flipped, channel-transposed weights."""
     wt = jnp.flip(w, axis=(0, 1)).swapaxes(2, 3)
-    return conv3x3(g, wt, block_n=block_n, interpret=interpret)
+    return conv3x3(g, wt, block_n=block_n, variant=variant,
+                   interpret=interpret)

@@ -183,6 +183,8 @@ def main(argv=None) -> int:
     p.add_argument("--src", default=".")
     p.add_argument("--command", default="")
     p.add_argument("--timeout-s", type=float, default=900.0)
+    p.add_argument("--poll-s", type=float, default=10.0,
+                   help="describe-poll interval for wait/up")
     p.add_argument("--dry-run", action="store_true")
     args = p.parse_args(argv)
 
@@ -193,7 +195,7 @@ def main(argv=None) -> int:
     elif args.cmd == "delete":
         pr.delete()
     elif args.cmd == "wait":
-        pr.wait_ready(timeout_s=args.timeout_s)
+        pr.wait_ready(timeout_s=args.timeout_s, poll_s=args.poll_s)
     elif args.cmd == "status":
         for d in pr.list():
             print(f"{d.get('name','?')}\t{d.get('state','?')}\t"
@@ -209,7 +211,7 @@ def main(argv=None) -> int:
     elif args.cmd == "up":
         # ec2 clean_launch_and_run (:916-928): one shot to a usable fleet.
         pr.create(args.accel, args.version, spot=args.spot)
-        pr.wait_ready(timeout_s=args.timeout_s)
+        pr.wait_ready(timeout_s=args.timeout_s, poll_s=args.poll_s)
         pr.write_hostfile(args.out, internal=not args.external_ips)
         pr.push(args.src)
     return 0

@@ -17,16 +17,17 @@ def _xla_conv(x, w):
         preferred_element_type=jnp.float32).astype(x.dtype)
 
 
+@pytest.mark.parametrize("variant", ["taps9", "im2col"])
 @pytest.mark.parametrize("shape,cout", [
     ((4, 8, 8, 16), 16),       # tiny, fast
     ((2, 32, 32, 64), 64),     # the trace's hot geometry (small batch)
     ((3, 8, 8, 16), 8),        # N not divisible by block_n; Cin != Cout
 ])
-def test_matches_xla_f32(shape, cout):
+def test_matches_xla_f32(shape, cout, variant):
     kx, kw = jax.random.split(jax.random.key(0))
     x = jax.random.normal(kx, shape, jnp.float32)
     w = jax.random.normal(kw, (3, 3, shape[-1], cout), jnp.float32) * 0.1
-    np.testing.assert_allclose(np.asarray(conv3x3(x, w)),
+    np.testing.assert_allclose(np.asarray(conv3x3(x, w, variant=variant)),
                                np.asarray(_xla_conv(x, w)),
                                rtol=1e-5, atol=1e-5)
 
@@ -42,14 +43,16 @@ def test_matches_xla_bf16():
         np.asarray(_xla_conv(x, w), np.float32), rtol=2e-2, atol=2e-2)
 
 
-def test_input_grad_matches_autodiff():
+@pytest.mark.parametrize("variant", ["taps9", "im2col"])
+def test_input_grad_matches_autodiff(variant):
     kx, kw, kg = jax.random.split(jax.random.key(2), 3)
     x = jax.random.normal(kx, (2, 8, 8, 16), jnp.float32)
     w = jax.random.normal(kw, (3, 3, 16, 16), jnp.float32) * 0.1
     g = jax.random.normal(kg, (2, 8, 8, 16), jnp.float32)
     _, vjp = jax.vjp(lambda xx: _xla_conv(xx, w), x)
-    np.testing.assert_allclose(np.asarray(conv3x3_input_grad(g, w)),
-                               np.asarray(vjp(g)[0]), rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(conv3x3_input_grad(g, w, variant=variant)),
+        np.asarray(vjp(g)[0]), rtol=1e-5, atol=1e-5)
 
 
 def test_rejects_bad_shapes():
@@ -58,3 +61,5 @@ def test_rejects_bad_shapes():
         conv3x3(x, jnp.zeros((5, 5, 16, 16)))
     with pytest.raises(ValueError, match="3,3"):
         conv3x3(x, jnp.zeros((3, 3, 8, 16)))
+    with pytest.raises(ValueError, match="variant"):
+        conv3x3(x, jnp.zeros((3, 3, 16, 16)), variant="winograd")
