@@ -41,7 +41,7 @@ BASELINES = {
 
 def _build(network, dataset, batch, *, mode="sync", num_aggregate=0,
            n_devices=None, dtype="bfloat16", fused=False, remat=False,
-           shard_update=False, lr=0.1):
+           shard_update=False, lr=0.1, conv_impl="xla"):
     from ps_pytorch_tpu.config import TrainConfig
     from ps_pytorch_tpu.data.datasets import DATASET_SHAPES
     from ps_pytorch_tpu.models import build_model
@@ -57,9 +57,11 @@ def _build(network, dataset, batch, *, mode="sync", num_aggregate=0,
                       lr=lr, momentum=0.9, weight_decay=1e-4,
                       compute_dtype=dtype, mode=mode,
                       num_aggregate=num_aggregate, fused_optimizer=fused,
-                      remat=remat, shard_update=shard_update)
+                      remat=remat, shard_update=shard_update,
+                      conv_impl=conv_impl)
     mesh = make_mesh(data=len(devices), devices=devices)
-    model = build_model(cfg.network, cfg.num_classes, cfg.compute_dtype)
+    model = build_model(cfg.network, cfg.num_classes, cfg.compute_dtype,
+                        conv_impl=cfg.conv_impl)
     tx = build_optimizer(cfg)
     h, w, c, ncls, _ = DATASET_SHAPES[dataset]
     if shard_update:
@@ -459,11 +461,13 @@ def bench_pallas_conv_ab(name, steps, *, batch=1024, hw=32, c=64):
     t_xla_bwd = timed(xla_bwd, x)       # x reused as the cotangent
     # Both MXU schedules (9 accumulating K=C dots vs one K=9C im2col dot);
     # the better one per direction is the prototype's number.
-    raw = {}
+    block_n = 4   # pinned + recorded: a tile-size change must never read
+    raw = {}      # as a kernel change in cross-round ratio comparisons
     for v in ("taps9", "im2col"):
-        raw[v] = (timed(lambda xx, ww: conv3x3(xx, ww, variant=v), x, w),
-                  timed(lambda gg, ww: conv3x3_input_grad(gg, ww, variant=v),
-                        x, w))
+        raw[v] = (timed(lambda xx, ww: conv3x3(
+                      xx, ww, variant=v, block_n=block_n), x, w),
+                  timed(lambda gg, ww: conv3x3_input_grad(
+                      gg, ww, variant=v, block_n=block_n), x, w))
     # Ratios/verdicts from RAW seconds; rounding is display-only.
     t_pl = min(f for f, _ in raw.values())
     t_pl_bwd = min(b for _, b in raw.values())
@@ -475,7 +479,7 @@ def bench_pallas_conv_ab(name, steps, *, batch=1024, hw=32, c=64):
     ratio_bwd = t_xla_bwd / t_pl_bwd
     on_tpu = platform == "tpu"
     return {"config": name, "platform": platform, "batch": batch,
-            "hw": hw, "channels": c,
+            "hw": hw, "channels": c, "block_n": block_n,
             "xla_ms": round(t_xla * 1e3, 3),
             "pallas_ms": round(t_pl * 1e3, 3),
             "xla_grad_input_ms": round(t_xla_bwd * 1e3, 3),
@@ -588,6 +592,13 @@ CONFIGS = {
         "lm_decode_b32", min(steps, 5), batch=32),
     "pallas_conv_ab": lambda steps: bench_pallas_conv_ab(
         "pallas_conv_ab", steps),
+    # Full-step A/B of the same experiment: the headline config with every
+    # stride-1 3x3 on the Pallas path (custom VJP — Pallas fwd+input-grad,
+    # XLA dW). images_per_sec vs resnet18_cifar10_dp is the adoption
+    # decision at step granularity.
+    "resnet18_pallas_conv": lambda steps: bench_throughput(
+        "resnet18_pallas_conv", "ResNet18", "synthetic", 1024, steps,
+        conv_impl="pallas"),
     "lenet_convergence": lambda steps: bench_time_to_loss(
         "lenet_convergence", "LeNet", "synthetic_mnist", 512,
         target_loss=0.8),

@@ -72,6 +72,7 @@ class TrainConfig:
     compute_dtype: str = "bfloat16"  # MXU-native compute dtype; params stay float32
     device_normalize: bool = True    # loaders ship raw uint8; the jitted step normalizes in-graph (4x less host->device traffic)
     fused_optimizer: bool = False    # Pallas single-pass SGD update (ops/fused_sgd.py)
+    conv_impl: str = "xla"           # xla | pallas (ResNet stride-1 3x3s via ops/pallas_conv.py; A/B'd on chip before any default change)
     donate: bool = True              # donate buffers to the jitted step
     remat: bool = False              # jax.checkpoint the forward for memory
 
@@ -134,6 +135,8 @@ class TrainConfig:
                              "(must be >= 1)")
         if self.grad_codec not in ("blosc", "int8"):
             raise ValueError(f"unknown grad_codec {self.grad_codec!r} (blosc | int8)")
+        if self.conv_impl not in ("xla", "pallas"):
+            raise ValueError(f"unknown conv_impl {self.conv_impl!r} (xla | pallas)")
         if self.nesterov and (self.momentum <= 0):
             raise ValueError("Nesterov momentum requires a momentum")
         if self.mode == "async" and self.publish_every > max(self.staleness_limit, 1):

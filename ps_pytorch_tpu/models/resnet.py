@@ -22,24 +22,64 @@ import jax.numpy as jnp
 Conv = partial(nn.Conv, use_bias=False)
 
 
+class PallasConv3x3(nn.Module):
+    """3x3 stride-1 SAME conv backed by the Pallas prototype
+    (ops/pallas_conv.py, custom VJP: Pallas fwd + input-grad, XLA dW).
+    Param name/shape/init match ``nn.Conv(use_bias=False)``, so ``xla`` and
+    ``pallas`` conv_impl checkpoints are interchangeable."""
+    features: int
+    dtype: Any = jnp.float32
+    variant: str = "taps9"
+
+    @nn.compact
+    def __call__(self, x):
+        from ps_pytorch_tpu.ops.pallas_conv import conv3x3_op
+        kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(),
+            (3, 3, x.shape[-1], self.features), jnp.float32)
+        return conv3x3_op(x.astype(self.dtype), kernel.astype(self.dtype),
+                          self.variant)
+
+
+def _conv3(planes, dtype, conv_impl, name=None):
+    """The 3x3 stride-1 conv used everywhere in the CIFAR ResNets: XLA by
+    default; the Pallas path when the A/B accepted it for this geometry."""
+    if conv_impl == "pallas":
+        return PallasConv3x3(planes, dtype=dtype, name=name)
+    return Conv(planes, (3, 3), padding=1, dtype=dtype, name=name)
+
+
 class BasicBlock(nn.Module):
     planes: int
     stride: int = 1
     dtype: Any = jnp.float32
+    conv_impl: str = "xla"
     expansion = 1
 
     @nn.compact
     def __call__(self, x, train: bool = True):
+        # Conv names are EXPLICIT and equal to the legacy flax auto-names
+        # ("Conv_<k>" in creation order): the pallas path substitutes a
+        # different module TYPE for the stride-1 3x3s, and auto-naming
+        # would both shift the numbering and collide across types —
+        # explicit names keep xla/pallas checkpoints interchangeable.
         norm = partial(nn.BatchNorm, use_running_average=not train,
                        momentum=0.9, epsilon=1e-5, dtype=self.dtype)
-        out = Conv(self.planes, (3, 3), strides=(self.stride, self.stride),
-                   padding=1, dtype=self.dtype)(x)
+        if self.stride == 1:
+            out = _conv3(self.planes, self.dtype, self.conv_impl,
+                         name="Conv_0")(x)
+        else:
+            out = Conv(self.planes, (3, 3),
+                       strides=(self.stride, self.stride),
+                       padding=1, dtype=self.dtype, name="Conv_0")(x)
         out = nn.relu(norm()(out))
-        out = Conv(self.planes, (3, 3), padding=1, dtype=self.dtype)(out)
+        out = _conv3(self.planes, self.dtype, self.conv_impl,
+                     name="Conv_1")(out)
         out = norm()(out)
         if self.stride != 1 or x.shape[-1] != self.planes * self.expansion:
             x = Conv(self.planes * self.expansion, (1, 1),
-                     strides=(self.stride, self.stride), dtype=self.dtype)(x)
+                     strides=(self.stride, self.stride), dtype=self.dtype,
+                     name="Conv_2")(x)
             x = norm()(x)
         return nn.relu(out + x)
 
@@ -48,21 +88,31 @@ class Bottleneck(nn.Module):
     planes: int
     stride: int = 1
     dtype: Any = jnp.float32
+    conv_impl: str = "xla"
     expansion = 4
 
     @nn.compact
     def __call__(self, x, train: bool = True):
+        # Explicit legacy names — see BasicBlock.
         norm = partial(nn.BatchNorm, use_running_average=not train,
                        momentum=0.9, epsilon=1e-5, dtype=self.dtype)
-        out = nn.relu(norm()(Conv(self.planes, (1, 1), dtype=self.dtype)(x)))
-        out = Conv(self.planes, (3, 3), strides=(self.stride, self.stride),
-                   padding=1, dtype=self.dtype)(out)
+        out = nn.relu(norm()(Conv(self.planes, (1, 1), dtype=self.dtype,
+                                  name="Conv_0")(x)))
+        if self.stride == 1:
+            out = _conv3(self.planes, self.dtype, self.conv_impl,
+                         name="Conv_1")(out)
+        else:
+            out = Conv(self.planes, (3, 3),
+                       strides=(self.stride, self.stride),
+                       padding=1, dtype=self.dtype, name="Conv_1")(out)
         out = nn.relu(norm()(out))
-        out = Conv(self.planes * self.expansion, (1, 1), dtype=self.dtype)(out)
+        out = Conv(self.planes * self.expansion, (1, 1), dtype=self.dtype,
+                   name="Conv_2")(out)
         out = norm()(out)
         if self.stride != 1 or x.shape[-1] != self.planes * self.expansion:
             x = Conv(self.planes * self.expansion, (1, 1),
-                     strides=(self.stride, self.stride), dtype=self.dtype)(x)
+                     strides=(self.stride, self.stride), dtype=self.dtype,
+                     name="Conv_3")(x)
             x = norm()(x)
         return nn.relu(out + x)
 
@@ -72,6 +122,8 @@ class ResNet(nn.Module):
     num_blocks: Sequence[int]
     num_classes: int = 10
     dtype: Any = jnp.float32
+    conv_impl: str = "xla"   # "pallas": stride-1 3x3s via ops/pallas_conv
+    # (stem conv1 stays XLA — C_in=3 starves the lane dimension)
     imagenet_stem: bool = False  # 7x7/s2 conv + 3x3/s2 maxpool (torchvision
     # semantics) for 224px inputs — the ResNet-50/ImageNet config is NEW vs
     # the reference (BASELINE.json config 5); the CIFAR stem is the
@@ -94,7 +146,8 @@ class ResNet(nn.Module):
                 zip((64, 128, 256, 512), self.num_blocks, (1, 2, 2, 2))):
             for i in range(n):
                 x = self.block(planes, stride if i == 0 else 1,
-                               dtype=self.dtype)(x, train=train)
+                               dtype=self.dtype,
+                               conv_impl=self.conv_impl)(x, train=train)
         if self.imagenet_stem:
             x = x.mean(axis=(1, 2))          # global average pool (7x7 -> 1)
         else:
@@ -104,23 +157,25 @@ class ResNet(nn.Module):
         return x.astype(jnp.float32)
 
 
-def ResNet18(num_classes=10, dtype=jnp.float32):
-    return ResNet(BasicBlock, (2, 2, 2, 2), num_classes, dtype)
+def ResNet18(num_classes=10, dtype=jnp.float32, conv_impl="xla"):
+    return ResNet(BasicBlock, (2, 2, 2, 2), num_classes, dtype, conv_impl)
 
-def ResNet34(num_classes=10, dtype=jnp.float32):
-    return ResNet(BasicBlock, (3, 4, 6, 3), num_classes, dtype)
+def ResNet34(num_classes=10, dtype=jnp.float32, conv_impl="xla"):
+    return ResNet(BasicBlock, (3, 4, 6, 3), num_classes, dtype, conv_impl)
 
-def ResNet50(num_classes=10, dtype=jnp.float32):
-    return ResNet(Bottleneck, (3, 4, 6, 3), num_classes, dtype)
+def ResNet50(num_classes=10, dtype=jnp.float32, conv_impl="xla"):
+    return ResNet(Bottleneck, (3, 4, 6, 3), num_classes, dtype, conv_impl)
 
-def ResNet101(num_classes=10, dtype=jnp.float32):
-    return ResNet(Bottleneck, (3, 4, 23, 3), num_classes, dtype)
+def ResNet101(num_classes=10, dtype=jnp.float32, conv_impl="xla"):
+    return ResNet(Bottleneck, (3, 4, 23, 3), num_classes, dtype, conv_impl)
 
-def ResNet152(num_classes=10, dtype=jnp.float32):
-    return ResNet(Bottleneck, (3, 8, 36, 3), num_classes, dtype)
+def ResNet152(num_classes=10, dtype=jnp.float32, conv_impl="xla"):
+    return ResNet(Bottleneck, (3, 8, 36, 3), num_classes, dtype, conv_impl)
 
-def ResNet18_ImageNet(num_classes=1000, dtype=jnp.float32):
-    return ResNet(BasicBlock, (2, 2, 2, 2), num_classes, dtype, imagenet_stem=True)
+def ResNet18_ImageNet(num_classes=1000, dtype=jnp.float32, conv_impl="xla"):
+    return ResNet(BasicBlock, (2, 2, 2, 2), num_classes, dtype, conv_impl,
+                  imagenet_stem=True)
 
-def ResNet50_ImageNet(num_classes=1000, dtype=jnp.float32):
-    return ResNet(Bottleneck, (3, 4, 6, 3), num_classes, dtype, imagenet_stem=True)
+def ResNet50_ImageNet(num_classes=1000, dtype=jnp.float32, conv_impl="xla"):
+    return ResNet(Bottleneck, (3, 4, 6, 3), num_classes, dtype, conv_impl,
+                  imagenet_stem=True)

@@ -53,10 +53,17 @@ def _conv_kernel(x_ref, w_ref, o_ref, acc, *, h, w, c_out, variant):
     """
     bt = o_ref.shape[0]
     c_in = x_ref.shape[-1]
-    taps = [x_ref[:, dy:dy + h, dx:dx + w, :].reshape(bt * h * w, c_in)
-            for dy in range(3) for dx in range(3)]
+
+    def tap(t):
+        # NOTE: laziness here is style, not VMEM control — the traced jaxpr
+        # is identical either way and Mosaic schedules by dataflow. VMEM
+        # residency is governed by block_n (and the im2col halving in
+        # conv3x3), not by where these slices appear in Python.
+        dy, dx = divmod(t, 3)
+        return x_ref[:, dy:dy + h, dx:dx + w, :].reshape(bt * h * w, c_in)
+
     if variant == "im2col":
-        patches = jnp.concatenate(taps, axis=1)          # [rows, 9C]
+        patches = jnp.concatenate([tap(t) for t in range(9)], axis=1)
         acc[:] = jax.lax.dot_general(
             patches, w_ref[:], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -64,7 +71,7 @@ def _conv_kernel(x_ref, w_ref, o_ref, acc, *, h, w, c_out, variant):
         acc[:] = jnp.zeros_like(acc)
         for t in range(9):
             acc[:] += jax.lax.dot_general(
-                taps[t], w_ref[t * c_in:(t + 1) * c_in, :],
+                tap(t), w_ref[t * c_in:(t + 1) * c_in, :],
                 (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
     o_ref[:] = acc[:].reshape(bt, h, w, c_out).astype(o_ref.dtype)
@@ -91,7 +98,7 @@ def _conv3x3(x, w, block_n, interpret, variant):
     )(xp, w2)
 
 
-def conv3x3(x, w, *, block_n: int = 8, variant: str = "taps9",
+def conv3x3(x, w, *, block_n: int = 4, variant: str = "taps9",
             interpret: Optional[bool] = None) -> jax.Array:
     """NHWC 3x3 stride-1 SAME conv. x [N,H,W,C] @ w [3,3,C,Co] -> [N,H,W,Co].
 
@@ -117,7 +124,7 @@ def conv3x3(x, w, *, block_n: int = 8, variant: str = "taps9",
     return _conv3x3(x, w, max(block_n, 1), interpret, variant)
 
 
-def conv3x3_input_grad(g, w, *, block_n: int = 8, variant: str = "taps9",
+def conv3x3_input_grad(g, w, *, block_n: int = 4, variant: str = "taps9",
                        interpret: Optional[bool] = None) -> jax.Array:
     """Gradient w.r.t. the conv INPUT — the trace's ``transpose(jvp)``
     backward twin. For stride-1 SAME, d/dx is itself a 3x3 SAME conv of the
@@ -125,3 +132,43 @@ def conv3x3_input_grad(g, w, *, block_n: int = 8, variant: str = "taps9",
     wt = jnp.flip(w, axis=(0, 1)).swapaxes(2, 3)
     return conv3x3(g, wt, block_n=block_n, variant=variant,
                    interpret=interpret)
+
+
+# ---------------------------------------------------------------------------
+# Differentiable op + flax module, so an accepted kernel is adoptable in the
+# headline model (a kernel that wins its microbench but can't be trained
+# through decides nothing).
+# ---------------------------------------------------------------------------
+
+@partial(jax.custom_vjp, nondiff_argnums=(2,))
+def conv3x3_op(x, w, variant="taps9"):
+    """Differentiable 3x3 SAME conv: Pallas forward, Pallas input-grad,
+    XLA weight-grad (dW was never the HBM-bound hotspot — the trace's top
+    ops are the activation-sized fwd/input-grad convs, PERF.md §7)."""
+    return conv3x3(x, w, variant=variant)
+
+
+def _conv_op_fwd(x, w, variant):
+    return conv3x3(x, w, variant=variant), (x, w)
+
+
+def _conv_op_bwd(variant, res, g):
+    x, w = res
+    dx = conv3x3_input_grad(g, w, variant=variant)
+    # dW[dy,dx,ci,co] = sum_{n,h,w} xpad[n,h+dy,w+dx,ci] g[n,h,w,co] —
+    # 9 contraction einsums, left to XLA (reduction-shaped, not the
+    # bandwidth-bound twin this prototype targets).
+    xp = jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)))
+    h, wd = x.shape[1], x.shape[2]
+    # f32 ACCUMULATION via preferred_element_type, not astype: upcasting
+    # the operands would let XLA materialize f32 copies of activation-sized
+    # tensors — HBM traffic this prototype exists to avoid.
+    taps = [jnp.einsum("nhwc,nhwd->cd",
+                       xp[:, dy:dy + h, dx:dx + wd, :], g,
+                       preferred_element_type=jnp.float32)
+            for dy in range(3) for dx in range(3)]
+    dw = jnp.stack(taps).reshape(3, 3, *taps[0].shape).astype(w.dtype)
+    return dx, dw
+
+
+conv3x3_op.defvjp(_conv_op_fwd, _conv_op_bwd)

@@ -59,7 +59,8 @@ class AsyncTrainer:
         # replicated over THIS process's local mesh (uncommitted arrays work
         # too, but explicit placement keeps every path uniform).
         self._rep = NamedSharding(self.mesh, _P())
-        self.model = build_model(cfg.network, cfg.num_classes, cfg.compute_dtype)
+        self.model = build_model(cfg.network, cfg.num_classes, cfg.compute_dtype,
+                                 conv_impl=cfg.conv_impl)
         self.tx = build_optimizer(cfg)
 
         shape = (1,) + sample_shape(cfg.dataset)

@@ -64,7 +64,8 @@ class Evaluator:
             self._build_lm(cfg)
             self._built_for = config_json
             return
-        self.model = build_model(cfg.network, cfg.num_classes, cfg.compute_dtype)
+        self.model = build_model(cfg.network, cfg.num_classes, cfg.compute_dtype,
+                                 conv_impl=cfg.conv_impl)
         # Template state for deserialization; single-device mesh is fine here.
         mesh = make_mesh(data=1)
         from ps_pytorch_tpu.data.datasets import sample_shape

@@ -89,7 +89,8 @@ class MultiSliceTrainer:
         self.fetch_every = max(fetch_every, 1)
         self.meshes = [make_mesh(data=per, devices=devices[i * per:(i + 1) * per])
                        for i in range(n_slices)]
-        self.model = build_model(cfg.network, cfg.num_classes, cfg.compute_dtype)
+        self.model = build_model(cfg.network, cfg.num_classes, cfg.compute_dtype,
+                                 conv_impl=cfg.conv_impl)
         self.tx = build_optimizer(cfg)
 
         shape = (1,) + sample_shape(cfg.dataset)

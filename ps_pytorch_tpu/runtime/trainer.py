@@ -45,7 +45,8 @@ class Trainer:
         self.mesh = mesh if mesh is not None else make_mesh(data=cfg.data_axis,
                                                             model=cfg.model_axis)
         self.n_data = self.mesh.shape["data"]
-        self.model = build_model(cfg.network, cfg.num_classes, cfg.compute_dtype)
+        self.model = build_model(cfg.network, cfg.num_classes, cfg.compute_dtype,
+                                 conv_impl=cfg.conv_impl)
         self.tx = build_optimizer(cfg)
         host_id, num_hosts = local_data_shard()
         self.train_loader, self.test_loader = prepare_data(
