@@ -91,18 +91,19 @@ def generate(params, prompt, *, n_new: int, vocab: int, d_model: int,
         logits = out[0] if n_experts else out   # MoE returns (logits, aux)
         return vars_["cache"], logits[:, 0]
 
-    # Materialize the cache structure with one throwaway step (flax
-    # creates "cache" variables on first use), then scan the real prompt.
-    cache0 = model.apply(
-        {"params": params}, jnp.zeros((b, 1), jnp.int32),
-        positions=jnp.zeros((1,), jnp.int32),
-        mutable=["cache"])[1]["cache"]
-    cache0 = jax.tree.map(jnp.zeros_like, cache0)
-
-    # Prefill: feed prompt tokens one at a time; keep only the last logits.
-    cache, logits_seq = jax.lax.scan(
-        step, cache0, (prompt.T, jnp.arange(s0, dtype=jnp.int32)))
-    last_logits = logits_seq[-1]
+    # One-shot prefill for BOTH families: the whole prompt through ONE
+    # forward — cached_attention accepts S>1, so the cache is created AND
+    # filled by a single MXU-shaped pass instead of s0 dispatch-bound scan
+    # steps. MoE decode dispatch is S-general too: MoEBlock sets
+    # n_groups = B*S in decode mode (one capacity group per token, top-k
+    # expert indices distinct within a group), so no assignment can drop
+    # at any S (moe.py MoEBlock; pinned by tests/test_generate.py's MoE
+    # parity cases).
+    out, vars_ = model.apply(
+        {"params": params}, prompt,
+        positions=jnp.arange(s0, dtype=jnp.int32), mutable=["cache"])
+    cache = vars_["cache"]
+    last_logits = (out[0] if n_experts else out)[:, -1]
 
     def sample_step(carry, pos):
         cache, logits, key = carry

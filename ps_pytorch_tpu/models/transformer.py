@@ -23,26 +23,31 @@ from ps_pytorch_tpu.parallel.ring import full_attention, ring_attention
 
 
 def cached_attention(mod: nn.Module, q, k, v, length: int):
-    """Single-query attention over a running k/v cache, shared by the
-    dense Block and MoEBlock decode paths (the cache variables live in the
+    """Causal attention over a running k/v cache, shared by the dense
+    Block and MoEBlock decode paths (the cache variables live in the
     CALLING module's "cache" collection).
 
-    q/k/v: [B, h, 1, hd]. Mirrors full_attention's numerics (scale, -inf
+    q/k/v: [B, h, S, hd] with ANY S >= 1 — S=1 is the per-token sampling
+    step; S>1 is one-shot prefill (the whole prompt written to the cache
+    in ONE forward pass, MXU-shaped, instead of S dispatch-bound scan
+    steps). Queries at cache offset i..i+S-1 attend causally: query t sees
+    cache slots <= i+t. Mirrors full_attention's numerics (scale, -inf
     mask, softmax) so decode logits match the training forward bit-for-bit
     up to reduction order (tests/test_generate.py pins the parity)."""
-    b, h, _, hd = q.shape
+    b, h, s, hd = q.shape
     ck = mod.variable("cache", "k", jnp.zeros, (b, h, length, hd), q.dtype)
     cv = mod.variable("cache", "v", jnp.zeros, (b, h, length, hd), q.dtype)
     idx = mod.variable("cache", "idx", lambda: jnp.zeros((), jnp.int32))
     i = idx.value
     ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, 0, i, 0))
     cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, 0, i, 0))
-    idx.value = i + 1
+    idx.value = i + s
     scale = hd ** -0.5
-    s = jnp.einsum("bhqd,bhkd->bhqk", q * scale, ck.value)
-    ok = (jnp.arange(length) <= i)[None, None, None, :]
-    s = jnp.where(ok, s, -jnp.inf)
-    p = jax.nn.softmax(s, axis=-1)
+    att = jnp.einsum("bhqd,bhkd->bhqk", q * scale, ck.value)
+    q_pos = i + jnp.arange(s)                                   # [S]
+    ok = jnp.arange(length)[None, :] <= q_pos[:, None]          # [S, length]
+    att = jnp.where(ok[None, None], att, -jnp.inf)
+    p = jax.nn.softmax(att, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", p, cv.value)
 
 
