@@ -1,0 +1,218 @@
+#!/usr/bin/env python
+"""Evidence index: one table over every committed benchmark/accuracy/memory
+artifact in the repo root.
+
+The repo accumulates per-round JSON artifacts (driver bench, suite runs,
+headline captures, accuracy oracles, memory probes, multichip dryruns,
+scaling tables). This tool is the one-command answer to "what is the
+current evidence and which rows are stale or failing" — each artifact
+family gets its newest-round file summarized with its key metric, platform,
+and an ok flag where the artifact defines one.
+
+    python -m ps_pytorch_tpu.tools.report            # table
+    python -m ps_pytorch_tpu.tools.report --json     # machine-readable
+
+Reference counterpart: none (the reference's evidence lived in notebook
+cells); closest in spirit to its analysis notebooks' summary tables.
+"""
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+
+def _round_of(path: str):
+    m = re.search(r"_r0*(\d+)", os.path.basename(path))
+    return int(m.group(1)) if m else -1
+
+
+def _newest(pattern: str, repo: str, exclude: str = ""):
+    """Newest-round file matching pattern (ties broken by name)."""
+    paths = sorted((p for p in glob.glob(os.path.join(repo, pattern))
+                    if not (exclude and exclude in os.path.basename(p))),
+                   key=lambda p: (_round_of(p), p))
+    return paths[-1] if paths else None
+
+
+def _load(path: str):
+    """Parse a whole-JSON or JSON-lines artifact.
+
+    Always returns a dict for single-object artifacts and a list for
+    JSON-lines ones; a malformed/truncated artifact returns
+    ``{"_parse_error": ...}`` so every family renders an ok=False row
+    instead of crashing the index (surfacing bad artifacts is the tool's
+    whole job)."""
+    with open(path) as f:
+        text = f.read().strip()
+    try:
+        return json.loads(text)
+    except ValueError:
+        rows = []
+        for line in text.splitlines():
+            try:
+                r = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(r, dict):
+                rows.append(r)
+        return rows if rows else {"_parse_error": f"unparseable: {path}"}
+
+
+def _suite_summary(rows):
+    if not isinstance(rows, list):
+        rows = [rows]
+    rows = [r for r in rows if isinstance(r, dict)]
+    errors = [r.get("config", r.get("_parse_error", "?")) for r in rows
+              if "error" in r or "_parse_error" in r]
+    # Row-level verdict flags: any False means the artifact of record
+    # carries a failing row (the exact situation VERDICT r4 weak #2
+    # flagged — a committed artifact contradicting the narrative).
+    bad_flags = []
+    flags = {}
+    for r in rows:
+        cfg = r.get("config", "")
+        if cfg == "lenet_convergence":
+            flags["converged"] = r.get("converged")
+            if r.get("converged") is False:
+                bad_flags.append(cfg)
+        if cfg.startswith("loader_vs_chip"):
+            flags[cfg] = r.get("ratio")
+            if r.get("ok") is False:
+                bad_flags.append(cfg)
+        if cfg == "pallas_conv_ab":
+            flags["pallas_accepted"] = r.get("accepted")
+    head = next((r for r in rows if r.get("config") == "resnet18_cifar10_dp"
+                 and "images_per_sec" in r), None)
+    return {
+        "rows": len(rows),
+        "value": head["images_per_sec"] if head else None,
+        "unit": "img/s (resnet18 dp)",
+        "platform": next((r.get("platform") for r in rows
+                          if r.get("platform")), "?"),
+        "ok": not errors and not bad_flags,
+        "errors": errors, "failing_rows": bad_flags, **flags,
+    }
+
+
+def collect(repo: str):
+    """One entry per artifact family: (label, path, summary dict)."""
+    out = []
+
+    def add(label, path, summary):
+        if path:
+            out.append({"family": label,
+                        "artifact": os.path.basename(path), **summary})
+
+    def as_dict(d):
+        """Guard: families that expect a dict get an error marker (and an
+        ok=False row) for list/garbage shapes instead of an AttributeError."""
+        if isinstance(d, dict):
+            return d
+        return {"_parse_error": f"expected object, got {type(d).__name__}"}
+
+    p = _newest("BENCH_r[0-9]*.json", repo, exclude="_headline")
+    if p:
+        d = as_dict(_load(p))
+        if "tail" in d and "value" not in d:
+            # Driver wrapper shape: the bench line is embedded in "tail".
+            # Dict-guarded like bench.py's _last_metric_line — a stray
+            # scalar/array line must not rebind d to a non-dict.
+            for line in reversed(d["tail"].splitlines()):
+                try:
+                    cand = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(cand, dict) and "metric" in cand:
+                    d = cand
+                    break
+        add("driver bench", p, {
+            "value": d.get("value"), "unit": d.get("unit"),
+            "platform": d.get("platform"),
+            "vs_baseline": d.get("vs_baseline"),
+            "ok": not d.get("fallback") and d.get("platform") == "tpu"})
+    p = _newest("BENCH_r*_headline.json", repo)
+    if p:
+        d = as_dict(_load(p))
+        add("headline capture", p, {
+            "value": d.get("value"), "unit": d.get("unit"),
+            "platform": d.get("platform"), "mfu": d.get("mfu"),
+            "vs_baseline": d.get("vs_baseline"),
+            "ok": d.get("platform") == "tpu"})
+    for pat, label, excl in (
+            ("BENCH_SUITE_r[0-9]*.json", "suite", "_quick"),
+            ("BENCH_SUITE_r*_quick.json", "suite (quick pass)", "")):
+        p = _newest(pat, repo, exclude=excl)
+        if p:
+            add(label, p, _suite_summary(_load(p)))
+    for pat, label, key in (
+            ("ACCURACY_r[0-9]*.json", "accuracy CNN", "prec1"),
+            ("ACCURACY_LM_r[0-9]*.json", "accuracy LM", "perplexity"),
+            ("ACCURACY_RESNET18*.json", "accuracy ResNet18", "prec1")):
+        p = _newest(pat, repo)
+        if p:
+            d = as_dict(_load(p))
+            add(label, p, {
+                "value": d.get(key), "unit": key,
+                "platform": d.get("platform"),
+                "ok": bool(d.get("met_target"))})
+    p = _newest("MEMORY_r[0-9]*.json", repo)
+    if p:
+        d = as_dict(_load(p))
+        rows = [r for r in d.get("rows", []) if isinstance(r, dict)]
+        add("memory probe", p, {
+            "value": len(rows), "unit": "modes",
+            "ok": bool(d.get("complete")) and
+            not any("error" in r for r in rows)})
+    p = _newest("MULTICHIP_r[0-9]*.json", repo)
+    if p:
+        d = as_dict(_load(p))
+        add("multichip dryrun", p, {
+            "value": d.get("n_devices"), "unit": "devices",
+            "ok": d.get("ok")})
+    p = _newest("SCALING_r[0-9]*.json", repo)
+    if p:
+        d = as_dict(_load(p))
+        add("scaling table", p, {
+            "value": ",".join(str(s) for s in d.get("sizes", [])),
+            "unit": "workers", "platform": d.get("platform"),
+            "ok": bool(d.get("modes"))})
+    p = os.path.join(repo, "COPYCHECK.json")
+    if os.path.exists(p):
+        d = as_dict(_load(p))
+        add("copycheck", p, {"value": len(d.get("flagged", [])),
+                             "unit": "flagged files",
+                             "ok": not d.get("flagged")
+                             and not d.get("error")
+                             and "_parse_error" not in d})
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--repo", default=os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))))
+    args = ap.parse_args(argv)
+    entries = collect(args.repo)
+    if args.json:
+        print(json.dumps(entries, indent=1))
+        return 0
+    cols = ("family", "artifact", "value", "unit", "platform", "ok")
+    widths = {c: max([len(c)] + [len(str(e.get(c, ""))) for e in entries])
+              for c in cols}
+    line = "  ".join(f"{{:{widths[c]}}}" for c in cols)
+    print(line.format(*cols))
+    for e in entries:
+        print(line.format(*(str(e.get(c, "")) for c in cols)))
+    stale = [e for e in entries if e.get("ok") is False]
+    print(f"\n{len(entries)} artifact families; "
+          f"{len(stale)} with ok=False: "
+          f"{[e['family'] for e in stale] or 'none'}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,75 @@
+"""Evidence-index tool (tools/report.py): artifact discovery, newest-round
+selection, wrapper/JSON-lines parsing, and the ok flags that surface
+stale/failing artifacts."""
+
+import json
+
+from ps_pytorch_tpu.tools import report
+
+
+def _write(d, name, obj):
+    p = d / name
+    p.write_text(json.dumps(obj) if not isinstance(obj, str) else obj)
+    return p
+
+
+def test_collect_newest_round_and_flags(tmp_path):
+    # Driver wrapper shape with an embedded CPU-fallback line -> ok False.
+    _write(tmp_path, "BENCH_r03.json", {"rc": 0, "tail": "noise\n" + json.dumps(
+        {"metric": "m", "value": 1.0, "unit": "u", "platform": "tpu"})})
+    _write(tmp_path, "BENCH_r04.json", {"rc": 0, "tail": json.dumps(
+        {"metric": "m", "value": 17.7, "unit": "images/sec",
+         "platform": "cpu", "fallback": "cpu", "vs_baseline": 0.04})})
+    # Headline: on-chip -> ok True. Must NOT be picked up as driver bench.
+    _write(tmp_path, "BENCH_r04_headline.json",
+           {"value": 28010.2, "unit": "images/sec", "platform": "tpu",
+            "mfu": 0.47, "vs_baseline": 67.5})
+    # Suite = JSON lines; a failing convergence row must flip ok False.
+    _write(tmp_path, "BENCH_SUITE_r03.json", "\n".join(json.dumps(r) for r in [
+        {"config": "resnet18_cifar10_dp", "images_per_sec": 28003.6,
+         "platform": "tpu"},
+        {"config": "lenet_convergence", "converged": False,
+         "platform": "tpu"},
+    ]))
+    # Quick-pass artifact is its own family, not the full suite.
+    _write(tmp_path, "BENCH_SUITE_r05_quick.json", json.dumps(
+        {"config": "resnet18_cifar10_dp", "images_per_sec": 29000.0,
+         "platform": "tpu"}))
+    _write(tmp_path, "ACCURACY_r03.json",
+           {"prec1": 0.99, "platform": "cpu", "met_target": True})
+    _write(tmp_path, "COPYCHECK.json", {"flagged": []})
+
+    entries = {e["family"]: e for e in report.collect(str(tmp_path))}
+    assert entries["driver bench"]["artifact"] == "BENCH_r04.json"
+    assert entries["driver bench"]["value"] == 17.7
+    assert entries["driver bench"]["ok"] is False          # cpu fallback
+    assert entries["headline capture"]["ok"] is True
+    assert entries["suite"]["artifact"] == "BENCH_SUITE_r03.json"
+    assert entries["suite"]["ok"] is False                 # failing row
+    assert entries["suite"]["failing_rows"] == ["lenet_convergence"]
+    assert entries["suite (quick pass)"]["value"] == 29000.0
+    assert entries["accuracy CNN"]["ok"] is True
+    assert entries["copycheck"]["ok"] is True
+
+
+def test_malformed_artifacts_flag_not_crash(tmp_path):
+    """Truncated/garbage artifacts must surface as ok=False rows — never
+    crash the index (that IS the tool's job)."""
+    _write(tmp_path, "ACCURACY_r04.json", '{"prec1": 0.9')      # truncated
+    _write(tmp_path, "BENCH_r04.json", json.dumps(
+        {"rc": 0, "tail": "0\n[1, 2]\nnot json"}))               # no metric
+    _write(tmp_path, "COPYCHECK.json", json.dumps(
+        {"flagged": [], "error": "scan crashed"}))
+    entries = {e["family"]: e for e in report.collect(str(tmp_path))}
+    assert entries["accuracy CNN"]["ok"] is False
+    assert entries["driver bench"]["ok"] is False
+    assert entries["copycheck"]["ok"] is False
+
+
+def test_cli_table_runs(tmp_path, capsys):
+    _write(tmp_path, "ACCURACY_r05.json",
+           {"prec1": 0.98, "platform": "tpu", "met_target": True})
+    assert report.main(["--repo", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "accuracy CNN" in out and "ACCURACY_r05.json" in out
+    assert report.main(["--repo", str(tmp_path), "--json"]) == 0
