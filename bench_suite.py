@@ -599,6 +599,13 @@ CONFIGS = {
     "resnet18_pallas_conv": lambda steps: bench_throughput(
         "resnet18_pallas_conv", "ResNet18", "synthetic", 1024, steps,
         conv_impl="pallas"),
+    # VGG-11 on the Pallas path at the committed vgg11_cifar100_kofn
+    # geometry (all 3x3 s1 convs past the stem, biased): the delta vs that
+    # row isolates the conv impl across VGG's channel ladder (64..512).
+    "vgg11_pallas_conv": lambda steps: bench_throughput(
+        "vgg11_pallas_conv", "VGG11", "synthetic_cifar100", 256, steps,
+        mode="kofn", num_aggregate=max(len(jax.devices()) - 1, 1),
+        conv_impl="pallas"),
     "lenet_convergence": lambda steps: bench_time_to_loss(
         "lenet_convergence", "LeNet", "synthetic_mnist", 512,
         target_loss=0.8),

@@ -47,8 +47,9 @@ def build_model(model_name: str, num_classes: int = 10,
                 conv_impl: str = "xla") -> Any:
     """Name -> Flax module (reference: ``util.py:8-19`` build_model).
 
-    ``conv_impl="pallas"`` swaps the ResNets' stride-1 3x3 convs for the
-    Pallas prototype (ops/pallas_conv.py); other families ignore it.
+    ``conv_impl="pallas"`` swaps the stride-1 3x3 convs of the ResNet and
+    VGG families for the Pallas prototype (ops/pallas_conv.py); other
+    families (LeNet's 5x5s) ignore it.
     """
     if isinstance(compute_dtype, str):
         compute_dtype = _DTYPES[compute_dtype]
@@ -57,7 +58,7 @@ def build_model(model_name: str, num_classes: int = 10,
     except KeyError:
         raise ValueError(
             f"unknown model {model_name!r}; choose from {sorted(_REGISTRY)}") from None
-    if conv_impl != "xla" and model_name.startswith("ResNet"):
+    if conv_impl != "xla" and model_name.startswith(("ResNet", "VGG")):
         return ctor(num_classes=num_classes, dtype=compute_dtype,
                     conv_impl=conv_impl)
     return ctor(num_classes=num_classes, dtype=compute_dtype)

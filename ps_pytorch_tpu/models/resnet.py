@@ -25,20 +25,29 @@ Conv = partial(nn.Conv, use_bias=False)
 class PallasConv3x3(nn.Module):
     """3x3 stride-1 SAME conv backed by the Pallas prototype
     (ops/pallas_conv.py, custom VJP: Pallas fwd + input-grad, XLA dW).
-    Param name/shape/init match ``nn.Conv(use_bias=False)``, so ``xla`` and
-    ``pallas`` conv_impl checkpoints are interchangeable."""
+    Param names/shapes/inits match ``nn.Conv``, so ``xla`` and ``pallas``
+    conv_impl checkpoints are interchangeable (ResNets: bias-free; VGG:
+    biased with He fan-out init — pass the same kernel_init/use_bias the
+    nn.Conv call sites use)."""
     features: int
     dtype: Any = jnp.float32
     variant: str = "taps9"
+    use_bias: bool = False
+    kernel_init: Any = nn.initializers.lecun_normal()
 
     @nn.compact
     def __call__(self, x):
         from ps_pytorch_tpu.ops.pallas_conv import conv3x3_op
         kernel = self.param(
-            "kernel", nn.initializers.lecun_normal(),
+            "kernel", self.kernel_init,
             (3, 3, x.shape[-1], self.features), jnp.float32)
-        return conv3x3_op(x.astype(self.dtype), kernel.astype(self.dtype),
-                          self.variant)
+        out = conv3x3_op(x.astype(self.dtype), kernel.astype(self.dtype),
+                         self.variant)
+        if self.use_bias:
+            bias = self.param("bias", nn.initializers.zeros,
+                              (self.features,), jnp.float32)
+            out = out + bias.astype(self.dtype)   # XLA fuses the add
+        return out
 
 
 def _conv3(planes, dtype, conv_impl, name=None):

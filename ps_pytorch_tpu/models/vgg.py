@@ -10,9 +10,12 @@ conv weights use He-normal init fan-out style (reference ``vgg.py:32-36``
 
 from typing import Any, Sequence, Union
 
+
 import flax.linen as nn
 import jax.numpy as jnp
 from jax.nn.initializers import variance_scaling
+
+from ps_pytorch_tpu.models.resnet import PallasConv3x3
 
 # He-style init over fan_out = k*k*out_channels, matching vgg.py:32-36.
 conv_init = variance_scaling(2.0, "fan_out", "normal")
@@ -32,22 +35,34 @@ class VGG(nn.Module):
     batch_norm: bool = False
     num_classes: int = 10
     dtype: Any = jnp.float32
+    conv_impl: str = "xla"   # "pallas": ops/pallas_conv for every conv
+    # past the stem (the 3-channel input conv starves the lane dim)
 
     @nn.compact
     def __call__(self, x, train: bool = True):
         # x: [B, 32, 32, 3] NHWC
         x = x.astype(self.dtype)
+        k = 0
         for v in self.cfg:
             if v == "M":
                 x = nn.max_pool(x, (2, 2), strides=(2, 2))
+                continue
+            # Conv names explicit and equal to the legacy flax auto-names
+            # (same reasoning as resnet.BasicBlock): xla/pallas
+            # checkpoints stay interchangeable.
+            if self.conv_impl == "pallas" and x.shape[-1] >= 8:
+                x = PallasConv3x3(v, dtype=self.dtype, use_bias=True,
+                                  kernel_init=conv_init,
+                                  name=f"Conv_{k}")(x)
             else:
                 x = nn.Conv(v, (3, 3), padding=1, dtype=self.dtype,
-                            kernel_init=conv_init)(x)
-                if self.batch_norm:
-                    x = nn.BatchNorm(use_running_average=not train,
-                                     momentum=0.9, epsilon=1e-5,
-                                     dtype=self.dtype)(x)
-                x = nn.relu(x)
+                            kernel_init=conv_init, name=f"Conv_{k}")(x)
+            k += 1
+            if self.batch_norm:
+                x = nn.BatchNorm(use_running_average=not train,
+                                 momentum=0.9, epsilon=1e-5,
+                                 dtype=self.dtype)(x)
+            x = nn.relu(x)
         x = x.reshape((x.shape[0], -1))  # [B, 512] after 5 pools on 32x32
         x = nn.Dropout(0.5, deterministic=not train)(x)
         x = nn.relu(nn.Dense(512, dtype=self.dtype)(x))
@@ -57,26 +72,26 @@ class VGG(nn.Module):
         return x.astype(jnp.float32)
 
 
-def VGG11(num_classes=10, dtype=jnp.float32):
-    return VGG(CFG["A"], False, num_classes, dtype)
+def VGG11(num_classes=10, dtype=jnp.float32, conv_impl="xla"):
+    return VGG(CFG["A"], False, num_classes, dtype, conv_impl)
 
-def VGG13(num_classes=10, dtype=jnp.float32):
-    return VGG(CFG["B"], False, num_classes, dtype)
+def VGG13(num_classes=10, dtype=jnp.float32, conv_impl="xla"):
+    return VGG(CFG["B"], False, num_classes, dtype, conv_impl)
 
-def VGG16(num_classes=10, dtype=jnp.float32):
-    return VGG(CFG["D"], False, num_classes, dtype)
+def VGG16(num_classes=10, dtype=jnp.float32, conv_impl="xla"):
+    return VGG(CFG["D"], False, num_classes, dtype, conv_impl)
 
-def VGG19(num_classes=10, dtype=jnp.float32):
-    return VGG(CFG["E"], False, num_classes, dtype)
+def VGG19(num_classes=10, dtype=jnp.float32, conv_impl="xla"):
+    return VGG(CFG["E"], False, num_classes, dtype, conv_impl)
 
-def VGG11_BN(num_classes=10, dtype=jnp.float32):
-    return VGG(CFG["A"], True, num_classes, dtype)
+def VGG11_BN(num_classes=10, dtype=jnp.float32, conv_impl="xla"):
+    return VGG(CFG["A"], True, num_classes, dtype, conv_impl)
 
-def VGG13_BN(num_classes=10, dtype=jnp.float32):
-    return VGG(CFG["B"], True, num_classes, dtype)
+def VGG13_BN(num_classes=10, dtype=jnp.float32, conv_impl="xla"):
+    return VGG(CFG["B"], True, num_classes, dtype, conv_impl)
 
-def VGG16_BN(num_classes=10, dtype=jnp.float32):
-    return VGG(CFG["D"], True, num_classes, dtype)
+def VGG16_BN(num_classes=10, dtype=jnp.float32, conv_impl="xla"):
+    return VGG(CFG["D"], True, num_classes, dtype, conv_impl)
 
-def VGG19_BN(num_classes=10, dtype=jnp.float32):
-    return VGG(CFG["E"], True, num_classes, dtype)
+def VGG19_BN(num_classes=10, dtype=jnp.float32, conv_impl="xla"):
+    return VGG(CFG["E"], True, num_classes, dtype, conv_impl)

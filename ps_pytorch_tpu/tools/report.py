@@ -171,7 +171,7 @@ def collect(repo: str):
         d = as_dict(_load(p))
         add("multichip dryrun", p, {
             "value": d.get("n_devices"), "unit": "devices",
-            "ok": d.get("ok")})
+            "ok": d.get("ok") is True})
     p = _newest("SCALING_r[0-9]*.json", repo)
     if p:
         d = as_dict(_load(p))

@@ -104,6 +104,22 @@ def test_resnet_conv_impl_pallas_matches_xla():
     assert max(jax.tree.leaves(deltas)) < 1e-5, deltas
 
 
+def test_vgg_conv_impl_pallas_matches_xla():
+    """VGG11 pallas build: same param tree (biased convs, He fan-out init)
+    and matching forward on shared params."""
+    from ps_pytorch_tpu.models import build_model
+    mx = build_model("VGG11", 10, "float32")
+    mp = build_model("VGG11", 10, "float32", conv_impl="pallas")
+    x = jax.random.normal(jax.random.key(0), (2, 32, 32, 3), jnp.float32)
+    vx = mx.init(jax.random.key(1), x, train=False)
+    vp = mp.init(jax.random.key(1), x, train=False)
+    assert jax.tree.structure(vx) == jax.tree.structure(vp)
+    ox = mx.apply(vx, x, train=False)
+    op = mp.apply(vx, x, train=False)
+    np.testing.assert_allclose(np.asarray(ox), np.asarray(op),
+                               rtol=1e-4, atol=1e-4)
+
+
 def test_bottleneck_pallas_param_tree_matches_xla():
     """ResNet50 (Bottleneck) structure pin via eval_shape: the explicit
     Conv_0..Conv_3 names must produce the same tree either impl — a naming

@@ -69,7 +69,7 @@ probe || exit 8
 # ---- 2. prime pass: every program the suite/accuracy stages will need ----
 for cfg in transformer_lm_2k transformer_lm_2k_remat transformer_lm_2k_flash \
            transformer_lm_8k_flash moe_lm_2k lm_decode_b1 lm_decode_b32 \
-           pallas_conv_ab resnet18_pallas_conv; do
+           pallas_conv_ab resnet18_pallas_conv vgg11_pallas_conv; do
   /usr/bin/time -f "PRIME ${cfg} %e s" timeout 2400 \
     python bench_suite.py --configs "$cfg" --steps 1 \
     >> "/tmp/suite_prime_${ROUND}.log" 2>&1
