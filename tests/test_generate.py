@@ -76,6 +76,32 @@ def test_sampling_seeded_and_shaped():
     assert int(jnp.max(a)) < GEO["vocab"] and int(jnp.min(a)) >= 0
 
 
+def test_chunked_prefill_matches_full_forward():
+    """cached_attention with S>1 at a NONZERO cache offset: feeding the
+    prompt in two chunks (S=5 then S=4) must reproduce the training
+    forward's logits at every position — pins the offset causal mask
+    (query t at offset i sees slots <= i+t), not just the offset-0 case
+    the generate() prefill exercises."""
+    params = _params()
+    m = TransformerLM(vocab_size=GEO["vocab"], d_model=GEO["d_model"],
+                      n_layers=GEO["n_layers"], n_heads=GEO["n_heads"],
+                      max_seq_len=64, attention_impl="full",
+                      decode=True, decode_cache_len=9)
+    toks = jnp.asarray(
+        np.random.default_rng(7).integers(0, GEO["vocab"], (2, 9)),
+        jnp.int32)
+    out1, v1 = m.apply({"params": params}, toks[:, :5],
+                       positions=jnp.arange(5), mutable=["cache"])
+    out2, _ = m.apply({"params": params, "cache": v1["cache"]},
+                      toks[:, 5:], positions=jnp.arange(5, 9),
+                      mutable=["cache"])
+    chunked = jnp.concatenate([out1, out2], axis=1)
+    full = _train_model(64).apply({"params": params}, toks,
+                                  positions=jnp.arange(9))
+    np.testing.assert_allclose(np.asarray(chunked), np.asarray(full),
+                               rtol=2e-5, atol=2e-5)
+
+
 def test_top_k_past_vocab_is_no_truncation():
     """top_k >= V must clamp to V (CLI default --top-k 40 vs small-vocab
     checkpoints), and behave exactly like untruncated sampling."""
