@@ -133,6 +133,22 @@ def test_bottleneck_pallas_param_tree_matches_xla():
     assert jax.tree.structure(sx) == jax.tree.structure(sp)
 
 
+@pytest.mark.slow
+def test_pallas_conv_under_8dev_spmd_step():
+    """The resnet18_pallas_conv suite row's exact path: conv3x3_op's
+    custom VJP inside the jitted masked-psum SPMD train step over the
+    8-device mesh (shard_map + donate + optimizer). A failure here would
+    otherwise first surface as a burned row budget on the chip."""
+    import bench_suite
+    state, step_fn, x, y, mask = bench_suite._build(
+        "ResNet18", "synthetic", 16, conv_impl="pallas", dtype="float32")
+    for i in range(2):
+        state, m = step_fn(state, x, y, mask, jax.random.key(i))
+    jax.block_until_ready(state.params)
+    assert np.isfinite(float(m["loss"]))
+    assert float(m["participating"]) == len(jax.devices())
+
+
 def test_rejects_bad_shapes():
     x = jnp.zeros((2, 8, 8, 16))
     with pytest.raises(ValueError, match="3,3"):
