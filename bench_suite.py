@@ -464,10 +464,17 @@ def bench_pallas_conv_ab(name, steps, *, batch=1024, hw=32, c=64):
     block_n = 4   # pinned + recorded: a tile-size change must never read
     raw = {}      # as a kernel change in cross-round ratio comparisons
     for v in ("taps9", "im2col"):
-        raw[v] = (timed(lambda xx, ww: conv3x3(
-                      xx, ww, variant=v, block_n=block_n), x, w),
-                  timed(lambda gg, ww: conv3x3_input_grad(
-                      gg, ww, variant=v, block_n=block_n), x, w))
+        # One jitted program per direction, symmetric with the XLA
+        # baselines: conv3x3_input_grad's weight flip/transpose would
+        # otherwise run as separate eager dispatches every iteration —
+        # pure tunnel-dispatch tax charged only to the Pallas side of the
+        # accept/reject ratio.
+        pl_fwd = jax.jit(
+            lambda xx, _v=v: conv3x3(xx, w, variant=_v, block_n=block_n))
+        pl_bwd = jax.jit(
+            lambda gg, _v=v: conv3x3_input_grad(gg, w, variant=_v,
+                                                block_n=block_n))
+        raw[v] = (timed(pl_fwd, x), timed(pl_bwd, x))
     # Ratios/verdicts from RAW seconds; rounding is display-only.
     t_pl = min(f for f, _ in raw.values())
     t_pl_bwd = min(b for _, b in raw.values())

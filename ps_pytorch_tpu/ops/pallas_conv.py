@@ -114,13 +114,16 @@ def conv3x3(x, w, *, block_n: int = 4, variant: str = "taps9",
         raise ValueError(f"unknown variant {variant!r}")
     if interpret is None:
         interpret = _interpret_default()
+    # im2col materializes [Bt*H*W, 9C] patches in VMEM — halve the batch
+    # tile to keep the block under the double-buffering budget. Halve
+    # BEFORE the divisibility shrink: halving afterwards could yield a
+    # block_n that no longer divides N, and grid = N // block_n would then
+    # silently leave the tail batch rows unwritten.
+    if variant == "im2col":
+        block_n = max(block_n // 2, 1)
     n = x.shape[0]
     while n % block_n:
         block_n //= 2
-    # im2col materializes [Bt*H*W, 9C] patches in VMEM — halve the batch
-    # tile to keep the block under the double-buffering budget.
-    if variant == "im2col":
-        block_n = max(block_n // 2, 1)
     return _conv3x3(x, w, max(block_n, 1), interpret, variant)
 
 
