@@ -93,8 +93,11 @@ for cfg in transformer_lm_2k transformer_lm_2k_remat transformer_lm_2k_flash \
   probe || { commit_artifacts "TPU ${ROUND} batch: partial (tunnel died in prime)"; exit 8; }
 done
 
-# ---- 3. full suite, warm cache ----
-timeout 14000 python bench_suite.py --steps 20 --isolate --row-timeout 600 \
+# ---- 3. full suite, warm cache. Invariant: outer ceiling > rows x row
+# budget (26 x 500 = 13000 < 14000) so children always expire on their
+# own timers, never SIGTERMed mid-RPC; 500 s/row is generous warm (all
+# cold compiles were primed in stage 2). ----
+timeout 14000 python bench_suite.py --steps 20 --isolate --row-timeout 500 \
     --markdown "BENCH_SUITE_${ROUND}.md" \
     > "BENCH_SUITE_${ROUND}.json.new" 2>"/tmp/suite_err_${ROUND}.log"
 SUITE_RC=$?
