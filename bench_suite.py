@@ -120,16 +120,18 @@ def bench_throughput(name, network, dataset, per_device_batch, steps, **kw):
             "vs_baseline_basis": "estimate" if base else None}
 
 
-def bench_input_pipeline(name, dataset, per_device_batch, steps):
+def bench_input_pipeline(name, dataset, per_device_batch, steps, workers=1):
     """Loader-only throughput at the headline config's batch size: full
-    augmentation stack (pad/crop/flip/normalize) + prefetch thread, no
+    augmentation stack (pad/crop/flip or RRC, normalize) + prefetch, no
     device in the loop. Compared against the training step's demand in
     main() (the loader must outrun the chip or it IS the bottleneck —
     VERDICT r1 item 4; reference capability: multiprocess loader,
-    my_data_loader.py:37-75)."""
+    my_data_loader.py:37-75). ``workers`` drives the loader's assembly
+    pool (0 = one per CPU) — the augmented ImageNet row runs it the way a
+    real host would."""
     from ps_pytorch_tpu.config import TrainConfig
     from ps_pytorch_tpu.data.augment import (
-        CROP_STACKS, input_norm_for, norm_constants_for,
+        CROP_STACKS, RRC_STACKS, input_norm_for, norm_constants_for,
     )
     from ps_pytorch_tpu.data.datasets import DataLoader, load_arrays
 
@@ -139,7 +141,7 @@ def bench_input_pipeline(name, dataset, per_device_batch, steps):
     dev_norm = input_norm_for(cfg) is not None
     x, y = load_arrays(cfg.dataset, cfg.data_dir, train=True, seed=0)
     loader = DataLoader(x, y, batch, cfg.dataset, train=True, seed=0,
-                        device_normalize=dev_norm)
+                        device_normalize=dev_norm, workers=workers)
     xb, _ = loader.next_batch()  # warm the prefetch thread (and bind xb
     #                              for the bytes row even at --steps 0)
     t0 = time.perf_counter()
@@ -149,7 +151,13 @@ def bench_input_pipeline(name, dataset, per_device_batch, steps):
         n_img += len(xb)
     dt = time.perf_counter() - t0
     ips = n_img / dt
-    stack = ("pad4+crop+flip" if dataset in CROP_STACKS else "shuffle+batch")
+    if dataset in RRC_STACKS:
+        h, w = xb.shape[1], xb.shape[2]
+        stack = f"rrc{h}x{w}+flip"
+    elif dataset in CROP_STACKS:
+        stack = "pad4+crop+flip"
+    else:
+        stack = "shuffle+batch"
     if not dev_norm and norm_constants_for(dataset) is not None:
         stack += "+normalize"
     return {"config": name, "dataset": dataset, "global_batch": batch,
@@ -163,6 +171,7 @@ def bench_input_pipeline(name, dataset, per_device_batch, steps):
             # storage bytes.
             "bytes_per_sec_mb": round(ips * xb.nbytes / len(xb) / 1e6, 1),
             "augment": stack,
+            "loader_workers": loader.workers,
             "device_normalize": dev_norm}
 
 
@@ -459,6 +468,8 @@ def bench_pallas_conv_ab(name, steps, *, batch=1024, hw=32, c=64):
 
     t_xla = timed(xla_conv, x, w)
     t_xla_bwd = timed(xla_bwd, x)       # x reused as the cotangent
+    from ps_pytorch_tpu.ops.pallas_conv import effective_block_n
+
     # Both MXU schedules (9 accumulating K=C dots vs one K=9C im2col dot);
     # the better one per direction is the prototype's number.
     block_n = 4   # pinned + recorded: a tile-size change must never read
@@ -478,8 +489,13 @@ def bench_pallas_conv_ab(name, steps, *, batch=1024, hw=32, c=64):
     # Ratios/verdicts from RAW seconds; rounding is display-only.
     t_pl = min(f for f, _ in raw.values())
     t_pl_bwd = min(b for _, b in raw.values())
+    # Per-variant EFFECTIVE tile (conv3x3 halves it for im2col before the
+    # divisibility shrink) — the tile each schedule really ran, so a
+    # cross-round ratio change can be told apart from a tile change
+    # (ADVICE r5 #3).
     variants = {v: {"fwd_ms": round(f * 1e3, 3),
-                    "grad_input_ms": round(b * 1e3, 3)}
+                    "grad_input_ms": round(b * 1e3, 3),
+                    "block_n": effective_block_n(batch, block_n, v)}
                 for v, (f, b) in raw.items()}
     flops = 2 * batch * hw * hw * c * c * 9
     ratio = t_xla / t_pl
@@ -624,6 +640,15 @@ CONFIGS = {
     # 1,666 img/s in BENCH_SUITE_r03.json, ~1.0 GB/s from this loader.
     "input_pipeline_imagenet": lambda steps: bench_input_pipeline(
         "input_pipeline_imagenet", "synthetic_imagenet", 32, steps),
+    # The REAL ImageNet train path: 256px uint8 store -> random-resized-
+    # crop -> bilinear 224 -> hflip (native kernel when built, counter-rng)
+    # through the multi-worker pool (workers=0: one per CPU). This row —
+    # not the augment-free one above — is what loader_vs_chip_demand_
+    # imagenet prefers: the 2.9x margin measured without augmentation was
+    # the optimistic bound (VERDICT r5 weak #4).
+    "input_pipeline_imagenet_augmented": lambda steps: bench_input_pipeline(
+        "input_pipeline_imagenet_augmented", "synthetic_imagenet_rrc", 32,
+        steps, workers=0),
 }
 
 
@@ -693,19 +718,26 @@ def main(argv=None) -> int:
 
     # Loader-vs-chip: when both the headline training config and the loader
     # bench ran, print their ratio — >= 2.0 means the input pipeline can
-    # feed the chip with headroom (VERDICT r1 item 4's done-bar).
-    for chip_cfg, loader_cfg, label in (
-            ("resnet18_cifar10_dp", "input_pipeline",
+    # feed the chip with headroom (VERDICT r1 item 4's done-bar). The
+    # ImageNet pairing PREFERS the augmented row (the real train path) and
+    # falls back to the augment-free one; loader_config records which fed
+    # the ratio so cross-round comparisons can't silently mix them.
+    for chip_cfg, loader_cfgs, label in (
+            ("resnet18_cifar10_dp", ("input_pipeline",),
              "loader_vs_chip_demand"),
-            ("resnet50_imagenet", "input_pipeline_imagenet",
+            ("resnet50_imagenet", ("input_pipeline_imagenet_augmented",
+                                   "input_pipeline_imagenet"),
              "loader_vs_chip_demand_imagenet")):
         chip = next((r for r in rows if r.get("config") == chip_cfg
                      and "images_per_sec" in r), None)
-        loader = next((r for r in rows if r.get("config") == loader_cfg
+        loader = next((r for c in loader_cfgs for r in rows
+                       if r.get("config") == c
                        and "loader_images_per_sec" in r), None)
         if chip and loader:
             ratio = loader["loader_images_per_sec"] / chip["images_per_sec"]
-            print(json.dumps({"config": label, "ratio": round(ratio, 2),
+            print(json.dumps({"config": label,
+                              "loader_config": loader["config"],
+                              "ratio": round(ratio, 2),
                               "ok": ratio >= 2.0}), flush=True)
 
     if args.markdown:

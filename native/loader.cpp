@@ -1,4 +1,5 @@
-// Native data-loader core: fused shuffle-gather + random-crop + hflip.
+// Native data-loader core: fused shuffle-gather + random-crop + hflip,
+// plus the ImageNet-geometry random-resized-crop kernel.
 //
 // The TPU-native answer to the reference's vendored multiprocess DataLoader
 // (my_data_loader.py:37-75 worker processes): the augmentation hot path as a
@@ -16,6 +17,44 @@
 
 #include <cstdint>
 #include <cstring>
+#include <vector>
+
+// ---------------------------------------------------------------------------
+// Random-resized-crop (ImageNet geometry): crop rect -> bilinear resize ->
+// hflip, uint8 in/out. ALL arithmetic is integer fixed-point (PSL_SHIFT
+// fractional bits per axis) so the numpy fallback in data/augment.py is
+// bit-identical by construction — no float path exists for -ffast-math /
+// FMA contraction to perturb. Crop rectangles and flips are sampled
+// host-side from a counter-based RNG (shared with the fallback); this
+// kernel only executes them.
+//
+// Sampling positions follow the half-pixel convention:
+//   src = (t + 0.5) * crop / out - 0.5  ==  ((2t+1)*crop - out) / (2*out)
+// quantized to PSL_SHIFT fractional bits, edges clamped. The resize is
+// separable: one horizontal pass per needed crop row into a rolling
+// two-row int32 cache, then a vertical combine per output row — each crop
+// row is resized at most once and the intermediate stays L1-resident.
+static const int PSL_SHIFT = 10;                 // weights in [0, 1<<10]
+static const int32_t PSL_ONE = 1 << PSL_SHIFT;   // h-pass result <= 255<<10
+                                                 // v-pass acc   <= 255<<20
+
+// Per-output-index sampling tables for one axis: i0/i1 source indices and
+// w0/w1 fixed-point weights. Mirrors _rrc_axis_tables in data/augment.py
+// exactly (same integer expressions, same clamps).
+static void psl_axis_tables(int64_t crop, int64_t out, int32_t *i0,
+                            int32_t *i1, int32_t *w0, int32_t *w1) {
+    for (int64_t t = 0; t < out; ++t) {
+        const int64_t num = (2 * t + 1) * crop - out;
+        int64_t fp = num > 0 ? (num << PSL_SHIFT) / (2 * out) : 0;
+        int64_t a = fp >> PSL_SHIFT;
+        int32_t fr = (int32_t)(fp & (PSL_ONE - 1));
+        if (a >= crop - 1) { a = crop - 1; fr = 0; }
+        i0[t] = (int32_t)a;
+        i1[t] = (int32_t)(a < crop - 1 ? a + 1 : a);
+        w0[t] = PSL_ONE - fr;
+        w1[t] = fr;
+    }
+}
 
 extern "C" {
 
@@ -44,6 +83,74 @@ void psl_crop_flip_batch(const uint8_t *padded, const int64_t *sel,
                 for (int64_t x = 0; x < w; ++x)
                     std::memcpy(dst_row + x * c,
                                 src_row + (w - 1 - x) * c, c);
+            }
+        }
+    }
+}
+
+// Random-resized-crop batch: for each output image i, crop
+// src[sel[i]][ys[i]:ys[i]+hs[i], xs[i]:xs[i]+ws[i]] and bilinear-resize it
+// to [oh, ow], mirroring columns when flip[i].
+//   src: [N, SH, SW, C] uint8   out: [B, OH, OW, C] uint8   (C-contiguous)
+//   sel int64[B]; ys/xs/hs/ws int32[B]; flip uint8[B]
+// Crop rects must satisfy 1 <= hs <= SH - ys, 1 <= ws <= SW - xs (the
+// host-side sampler guarantees this; out-of-range rects read garbage).
+void psl_rrc_batch(const uint8_t *src, const int64_t *sel, const int32_t *ys,
+                   const int32_t *xs, const int32_t *hs, const int32_t *ws,
+                   const uint8_t *flip, uint8_t *out, int64_t b, int64_t sh,
+                   int64_t sw, int64_t c, int64_t oh, int64_t ow) {
+    const int64_t img_in = sh * sw * c;
+    const int64_t row_in = sw * c;
+    const int64_t img_out = oh * ow * c;
+    const int64_t row_out = ow * c;
+#pragma omp parallel
+    {
+        // Per-thread scratch: 4 column tables + y tables + 2 cached rows.
+        std::vector<int32_t> xi0(ow), xi1(ow), wx0(ow), wx1(ow);
+        std::vector<int32_t> yi0(oh), yi1(oh), wy0(oh), wy1(oh);
+        std::vector<int32_t> rows(2 * row_out);
+#pragma omp for schedule(static)
+        for (int64_t i = 0; i < b; ++i) {
+            const int64_t ch = hs[i], cw = ws[i];
+            const uint8_t *crop =
+                src + sel[i] * img_in + ys[i] * row_in + (int64_t)xs[i] * c;
+            uint8_t *dst = out + i * img_out;
+            psl_axis_tables(cw, ow, xi0.data(), xi1.data(), wx0.data(),
+                            wx1.data());
+            if (flip[i]) {  // mirror the column tables == flip the output
+                for (int64_t t = 0; t < ow / 2; ++t) {
+                    const int64_t m = ow - 1 - t;
+                    std::swap(xi0[t], xi0[m]); std::swap(xi1[t], xi1[m]);
+                    std::swap(wx0[t], wx0[m]); std::swap(wx1[t], wx1[m]);
+                }
+            }
+            psl_axis_tables(ch, oh, yi0.data(), yi1.data(), wy0.data(),
+                            wy1.data());
+            int64_t cached[2] = {-1, -1};   // crop row held in slot [y & 1]
+            for (int64_t r = 0; r < oh; ++r) {
+                const int64_t y0 = yi0[r], y1 = yi1[r];
+                for (int64_t k = 0; k < 2; ++k) {   // ensure rows y0, y1
+                    const int64_t y = k ? y1 : y0;
+                    int32_t *slot = rows.data() + (y & 1) * row_out;
+                    if (cached[y & 1] == y) continue;
+                    cached[y & 1] = y;
+                    const uint8_t *srow = crop + y * row_in;
+                    for (int64_t t = 0; t < ow; ++t) {
+                        const uint8_t *p0 = srow + (int64_t)xi0[t] * c;
+                        const uint8_t *p1 = srow + (int64_t)xi1[t] * c;
+                        const int32_t a = wx0[t], bb = wx1[t];
+                        for (int64_t c2 = 0; c2 < c; ++c2)
+                            slot[t * c + c2] = a * p0[c2] + bb * p1[c2];
+                    }
+                }
+                const int32_t *r0 = rows.data() + (y0 & 1) * row_out;
+                const int32_t *r1 = rows.data() + (y1 & 1) * row_out;
+                const int32_t a = wy0[r], bb = wy1[r];
+                uint8_t *drow = dst + r * row_out;
+                const int32_t half = 1 << (2 * PSL_SHIFT - 1);
+                for (int64_t t = 0; t < row_out; ++t)   // contiguous: SIMD
+                    drow[t] = (uint8_t)((a * r0[t] + bb * r1[t] + half) >>
+                                        (2 * PSL_SHIFT));
             }
         }
     }

@@ -30,6 +30,7 @@ class TrainConfig:
     test_batch_size: int = 1000
     data_dir: str = "./data"
     num_classes: int = 0             # 0 = infer from dataset (Cifar100 -> 100, distributed_nn.py:111-114)
+    loader_workers: int = 1          # train-loader assembly threads; 0 = one per CPU (datasets.DataLoader workers)
 
     # -- optimization (reference: distributed_nn.py:36-44, optim/sgd.py, optim/adam.py) --
     optimizer: str = "sgd"           # sgd|adam
@@ -72,7 +73,7 @@ class TrainConfig:
     compute_dtype: str = "bfloat16"  # MXU-native compute dtype; params stay float32
     device_normalize: bool = True    # loaders ship raw uint8; the jitted step normalizes in-graph (4x less host->device traffic)
     fused_optimizer: bool = False    # Pallas single-pass SGD update (ops/fused_sgd.py)
-    conv_impl: str = "xla"           # xla | pallas (ResNet stride-1 3x3s via ops/pallas_conv.py; A/B'd on chip before any default change)
+    conv_impl: str = "xla"           # xla | pallas | pallas_im2col (ResNet/VGG stride-1 3x3s via ops/pallas_conv.py; A/B'd on chip before any default change)
     donate: bool = True              # donate buffers to the jitted step
     remat: bool = False              # jax.checkpoint the forward for memory
 
@@ -135,8 +136,12 @@ class TrainConfig:
                              "(must be >= 1)")
         if self.grad_codec not in ("blosc", "int8"):
             raise ValueError(f"unknown grad_codec {self.grad_codec!r} (blosc | int8)")
-        if self.conv_impl not in ("xla", "pallas"):
-            raise ValueError(f"unknown conv_impl {self.conv_impl!r} (xla | pallas)")
+        if self.conv_impl not in ("xla", "pallas", "pallas_im2col"):
+            raise ValueError(f"unknown conv_impl {self.conv_impl!r} "
+                             "(xla | pallas | pallas_im2col)")
+        if self.loader_workers < 0:
+            raise ValueError(f"loader_workers={self.loader_workers} "
+                             "(must be >= 0; 0 = one per CPU)")
         if self.nesterov and (self.momentum <= 0):
             raise ValueError("Nesterov momentum requires a momentum")
         if self.mode == "async" and self.publish_every > max(self.staleness_limit, 1):

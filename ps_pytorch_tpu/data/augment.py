@@ -26,6 +26,8 @@ def _configure_loader(lib: "ctypes.CDLL") -> None:
     lib.psl_crop_flip_batch.argtypes = [ctypes.c_void_p] * 6 + \
         [ctypes.c_int64] * 6
     lib.psl_crop_flip_batch.restype = None
+    lib.psl_rrc_batch.argtypes = [ctypes.c_void_p] * 8 + [ctypes.c_int64] * 6
+    lib.psl_rrc_batch.restype = None
 
 
 def _load_native_loader():
@@ -44,6 +46,8 @@ CIFAR_MEAN = np.array([125.3, 123.0, 113.9], np.float32) / 255.0
 CIFAR_STD = np.array([63.0, 62.1, 66.7], np.float32) / 255.0
 SVHN_MEAN = np.array([0.4914, 0.4822, 0.4465], np.float32)
 SVHN_STD = np.array([0.2023, 0.1994, 0.2010], np.float32)
+IMAGENET_MEAN = np.array([0.485, 0.456, 0.406], np.float32)
+IMAGENET_STD = np.array([0.229, 0.224, 0.225], np.float32)
 
 
 def normalize(x: np.ndarray, mean, std) -> np.ndarray:
@@ -154,6 +158,206 @@ def crop_flip_prepadded(padded: np.ndarray, sel: np.ndarray,
     return out
 
 
+# ---------------------------------------------------------------------------
+# ImageNet-geometry random-resized-crop (area/aspect jitter -> bilinear
+# resize -> hflip), the reference's known-hard input path (SURVEY §7,
+# my_data_loader.py). Two implementations with ONE arithmetic contract:
+# the native OpenMP kernel (native/loader.cpp psl_rrc_batch, GIL-released)
+# and the vectorized numpy fallback below. Both use integer fixed-point
+# separable bilinear (RRC_SHIFT fractional bits per axis), so they are
+# bit-identical — CPU CI proves the native kernel against the fallback
+# (tests/test_augment_rrc.py), the same contract crop_flip_prepadded has.
+#
+# Crop rectangles and flips come from a COUNTER-BASED RNG (splitmix64 over
+# a per-image counter): any worker can sample any image's parameters
+# independently of batch order, which is what makes the multi-worker
+# loader pool (datasets.DataLoader workers>1) deterministic and
+# bit-identical to the single-worker path.
+# ---------------------------------------------------------------------------
+
+RRC_SHIFT = 10                      # fixed-point fractional bits per axis
+_RRC_ONE = 1 << RRC_SHIFT
+_RRC_ATTEMPTS = 10                  # torchvision RandomResizedCrop protocol
+
+
+def _mix64(x: np.ndarray) -> np.ndarray:
+    """Vectorized splitmix64 finalizer over uint64 (wraps mod 2^64)."""
+    with np.errstate(over="ignore"):
+        x = (x + np.uint64(0x9E3779B97F4A7C15)).astype(np.uint64)
+        x ^= x >> np.uint64(30)
+        x = (x * np.uint64(0xBF58476D1CE4E5B9)).astype(np.uint64)
+        x ^= x >> np.uint64(27)
+        x = (x * np.uint64(0x94D049BB133111EB)).astype(np.uint64)
+        return x ^ (x >> np.uint64(31))
+
+
+def _counter_uniforms(seed: int, counters: np.ndarray, n: int) -> np.ndarray:
+    """[B, n] uniforms in [0,1), each a pure function of (seed, counter, j)
+    — the order-independent stream the RRC sampler draws from."""
+    c = np.asarray(counters, np.uint64)
+    with np.errstate(over="ignore"):
+        base = _mix64(c ^ _mix64(np.uint64(0xABCD) + np.uint64(seed)))
+        js = (np.arange(1, n + 1, dtype=np.uint64)
+              * np.uint64(0x9E3779B97F4A7C15))
+        bits = _mix64(base[:, None] + js[None, :])
+    return (bits >> np.uint64(11)).astype(np.float64) * (2.0 ** -53)
+
+
+def rrc_params(seed: int, counters: np.ndarray, src_h: int, src_w: int,
+               scale=(0.08, 1.0), ratio=(3.0 / 4.0, 4.0 / 3.0)):
+    """Sample torchvision-protocol RandomResizedCrop rects + hflips for a
+    batch: up to 10 attempts of (area uniform in scale*src_area, aspect
+    log-uniform in ratio), first in-bounds attempt wins, center-crop
+    fallback otherwise. Counter-based (see _counter_uniforms): a given
+    (seed, counter) always yields the same rect, whatever batch/worker it
+    lands in. Returns (ys, xs, hs, ws int32[B], flip uint8[B]); rects are
+    guaranteed in-bounds with hs, ws >= 1.
+
+    Sampling runs host-side in float64 numpy and is SHARED by the native
+    and numpy execution paths — bit-exactness between them never depends
+    on this function, only on the fixed-point resize."""
+    b = len(counters)
+    u = _counter_uniforms(seed, counters, 4 * _RRC_ATTEMPTS + 1)
+    area = float(src_h * src_w)
+    ua = u[:, 0:4 * _RRC_ATTEMPTS:4]            # [B, attempts]
+    ur = u[:, 1:4 * _RRC_ATTEMPTS:4]
+    uy = u[:, 2:4 * _RRC_ATTEMPTS:4]
+    ux = u[:, 3:4 * _RRC_ATTEMPTS:4]
+    target = area * (scale[0] + (scale[1] - scale[0]) * ua)
+    log_r = np.log(ratio[0]) + (np.log(ratio[1]) - np.log(ratio[0])) * ur
+    ar = np.exp(log_r)
+    ws_c = np.round(np.sqrt(target * ar)).astype(np.int64)
+    hs_c = np.round(np.sqrt(target / ar)).astype(np.int64)
+    ok = (ws_c > 0) & (ws_c <= src_w) & (hs_c > 0) & (hs_c <= src_h)
+    first = np.argmax(ok, axis=1)               # first valid attempt
+    rows = np.arange(b)
+    hs = hs_c[rows, first]
+    ws = ws_c[rows, first]
+    ys = np.floor(uy[rows, first] * (src_h - hs + 1)).astype(np.int64)
+    xs = np.floor(ux[rows, first] * (src_w - ws + 1)).astype(np.int64)
+    # Fallback (no attempt fit): torchvision's center crop at the nearest
+    # in-range aspect ratio.
+    none_ok = ~ok.any(axis=1)
+    if none_ok.any():
+        in_ratio = src_w / src_h
+        if in_ratio < ratio[0]:
+            fw, fh = src_w, min(int(round(src_w / ratio[0])), src_h)
+        elif in_ratio > ratio[1]:
+            fh, fw = src_h, min(int(round(src_h * ratio[1])), src_w)
+        else:
+            fw, fh = src_w, src_h
+        hs = np.where(none_ok, fh, hs)
+        ws = np.where(none_ok, fw, ws)
+        ys = np.where(none_ok, (src_h - fh) // 2, ys)
+        xs = np.where(none_ok, (src_w - fw) // 2, xs)
+    hs = np.maximum(hs, 1)
+    ws = np.maximum(ws, 1)
+    flip = (u[:, 4 * _RRC_ATTEMPTS] < 0.5).astype(np.uint8)
+    return (ys.astype(np.int32), xs.astype(np.int32),
+            hs.astype(np.int32), ws.astype(np.int32), flip)
+
+
+def _rrc_axis_tables(crop: int, out: int):
+    """Fixed-point bilinear sampling tables for one axis (half-pixel
+    convention, edge-clamped): (i0, i1, w0, w1), w0 + w1 == 1<<RRC_SHIFT.
+    Integer expressions mirror native/loader.cpp psl_axis_tables exactly."""
+    t = np.arange(out, dtype=np.int64)
+    num = (2 * t + 1) * crop - out
+    fp = np.where(num > 0, (num << RRC_SHIFT) // (2 * out), 0)
+    i0 = fp >> RRC_SHIFT
+    fr = fp & (_RRC_ONE - 1)
+    at_edge = i0 >= crop - 1
+    i0 = np.where(at_edge, crop - 1, i0)
+    fr = np.where(at_edge, 0, fr)
+    i1 = np.minimum(i0 + 1, crop - 1)
+    return (i0.astype(np.int64), i1.astype(np.int64),
+            (_RRC_ONE - fr).astype(np.int32), fr.astype(np.int32))
+
+
+def _rrc_numpy(src, sel, ys, xs, hs, ws, flip, oh, ow, out):
+    """Numpy reference for psl_rrc_batch: per-image vectorized separable
+    fixed-point bilinear, int32 accumulation — bit-identical to the native
+    kernel (same tables, same rounding, same flip-by-mirrored-tables)."""
+    for i in range(len(sel)):
+        ch, cw = int(hs[i]), int(ws[i])
+        crop = src[sel[i], ys[i]:ys[i] + ch,
+                   xs[i]:xs[i] + cw].astype(np.int32)
+        xi0, xi1, wx0, wx1 = _rrc_axis_tables(cw, ow)
+        if flip[i]:
+            xi0, xi1 = xi0[::-1], xi1[::-1]
+            wx0, wx1 = wx0[::-1], wx1[::-1]
+        yi0, yi1, wy0, wy1 = _rrc_axis_tables(ch, oh)
+        # Horizontal pass: [ch, ow, C] int32, values <= 255 << RRC_SHIFT.
+        hbuf = (wx0[None, :, None] * crop[:, xi0]
+                + wx1[None, :, None] * crop[:, xi1])
+        v = (wy0[:, None, None].astype(np.int32) * hbuf[yi0]
+             + wy1[:, None, None].astype(np.int32) * hbuf[yi1]
+             + (1 << (2 * RRC_SHIFT - 1)))
+        out[i] = (v >> (2 * RRC_SHIFT)).astype(np.uint8)
+    return out
+
+
+def rrc_batch(src: np.ndarray, sel: np.ndarray, ys, xs, hs, ws, flip,
+              oh: int, ow: int,
+              out: Optional[np.ndarray] = None) -> np.ndarray:
+    """Execute sampled RRC rects: gather + crop + bilinear-resize + hflip
+    in one pass. Native OpenMP kernel (GIL-released) when available and
+    the batch is uint8/contiguous; bit-identical numpy otherwise."""
+    b = len(sel)
+    c = src.shape[-1]
+    if out is None:
+        out = np.empty((b, oh, ow, c), np.uint8)
+    lib = _load_native_loader()
+    if (lib is not None and src.dtype == np.uint8 and out.dtype == np.uint8
+            and out.shape == (b, oh, ow, c)
+            and src.flags.c_contiguous and out.flags.c_contiguous):
+        sel64 = np.ascontiguousarray(sel, np.int64)
+        ys32 = np.ascontiguousarray(ys, np.int32)
+        xs32 = np.ascontiguousarray(xs, np.int32)
+        hs32 = np.ascontiguousarray(hs, np.int32)
+        ws32 = np.ascontiguousarray(ws, np.int32)
+        fl8 = np.ascontiguousarray(flip, np.uint8)
+        lib.psl_rrc_batch(
+            src.ctypes.data, sel64.ctypes.data, ys32.ctypes.data,
+            xs32.ctypes.data, hs32.ctypes.data, ws32.ctypes.data,
+            fl8.ctypes.data, out.ctypes.data,
+            b, src.shape[1], src.shape[2], c, oh, ow)
+        return out
+    if src.dtype != np.uint8:
+        src = src.astype(np.uint8)  # contract: uint8 in, uint8 out
+    return _rrc_numpy(src, sel, ys, xs, hs, ws, flip, oh, ow, out)
+
+
+def random_resized_crop(src: np.ndarray, sel: np.ndarray,
+                        counters: np.ndarray, seed: int, oh: int, ow: int,
+                        scale=(0.08, 1.0), ratio=(3.0 / 4.0, 4.0 / 3.0),
+                        out: Optional[np.ndarray] = None) -> np.ndarray:
+    """Sample (counter-based) + execute RRC for a batch of source indices:
+    src [N,SH,SW,C] uint8 -> [B,oh,ow,C] uint8."""
+    ys, xs, hs, ws, flip = rrc_params(seed, counters, src.shape[1],
+                                      src.shape[2], scale, ratio)
+    return rrc_batch(src, sel, ys, xs, hs, ws, flip, oh, ow, out)
+
+
+def center_crop(x: np.ndarray, oh: int, ow: int) -> np.ndarray:
+    """Deterministic eval-path geometry for RRC datasets: plain center
+    crop (storage is decode-sized >= output, e.g. 256 -> 224)."""
+    h, w = x.shape[1], x.shape[2]
+    if (h, w) == (oh, ow):
+        return x
+    y0, x0 = (h - oh) // 2, (w - ow) // 2
+    return x[:, y0:y0 + oh, x0:x0 + ow]
+
+
+# RRC-augmented datasets -> (scale range, aspect-ratio range). Output
+# geometry comes from datasets.DATASET_SHAPES (the model-facing shape);
+# storage is the decode-sized store (datasets._STORAGE_HW).
+RRC_STACKS = {
+    "ImageNet": ((0.08, 1.0), (3.0 / 4.0, 4.0 / 3.0)),
+    "synthetic_imagenet_rrc": ((0.08, 1.0), (3.0 / 4.0, 4.0 / 3.0)),
+}
+
+
 # Crop-augmented datasets -> (pad, np.pad mode). The loader keys its
 # pre-padded fast path off this table; augment_train uses the same values.
 CROP_STACKS = {
@@ -174,6 +378,12 @@ def norm_constants_for(dataset: str):
         return CIFAR_MEAN, CIFAR_STD
     if dataset == "SVHN":
         return SVHN_MEAN, SVHN_STD
+    if dataset in ("ImageNet", "synthetic_imagenet_rrc"):
+        # Standard ImageNet constants (the reference's Normalize stack).
+        # Plain `synthetic_imagenet` intentionally stays None so the
+        # augment-free input_pipeline_imagenet bench row keeps measuring
+        # the bare gather path it always has.
+        return IMAGENET_MEAN, IMAGENET_STD
     return None
 
 

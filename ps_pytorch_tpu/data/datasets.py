@@ -19,6 +19,7 @@ the files are already on disk (``data_prepare.py`` pre-download contract);
 downloads are attempted only when ``download=True``.
 """
 
+import os
 import queue
 import threading
 from typing import Iterator, Optional, Tuple
@@ -50,6 +51,21 @@ DATASET_SHAPES = {
     # (BASELINE.json config 5); small N — it exists to exercise 224px
     # shapes/throughput, not to be learned.
     "synthetic_imagenet": (224, 224, 3, 1000, 512),
+    # ImageNet-geometry set with the REAL augment pipeline: decode-sized
+    # 256px uint8 storage (_STORAGE_HW) run through random-resized-crop ->
+    # bilinear 224 -> hflip (augment.RRC_STACKS) on every train batch.
+    # The model-facing shape below is the RRC OUTPUT; the plain
+    # `synthetic_imagenet` row keeps measuring the augment-free gather.
+    "synthetic_imagenet_rrc": (224, 224, 3, 1000, 512),
+}
+
+# Datasets whose ON-DISK/IN-RAM storage geometry differs from the
+# model-facing shape in DATASET_SHAPES: RRC datasets store decode-sized
+# images and the loader's augment (train) / center-crop (eval) produces
+# the model shape. ImageNet convention: 256px short-side storage.
+_STORAGE_HW = {
+    "ImageNet": (256, 256),
+    "synthetic_imagenet_rrc": (256, 256),
 }
 
 
@@ -88,6 +104,7 @@ def _load_files(name: str, root: str, train: bool, download: bool):
 
 def _synthetic(name: str, train: bool, seed: int = 0):
     h, w, c, ncls, n = DATASET_SHAPES[name]
+    h, w = _STORAGE_HW.get(name, (h, w))   # RRC sets store decode-sized
     if not train:
         # Test split ~1/6 of train with a floor, but never bigger than the
         # train hint (keeps large-image synthetic sets memory-bounded).
@@ -98,9 +115,9 @@ def _synthetic(name: str, train: bool, seed: int = 0):
     x = rng.normal(0.5, 0.25, size=(n, h, w, c)).astype(np.float32)
     x += (y[:, None, None, None].astype(np.float32) / ncls - 0.5) * 0.5
     x = np.clip(x, 0.0, 1.0)
-    if name == "synthetic_cifar10":
+    if name == "synthetic_cifar10" or name in augment.RRC_STACKS:
         # Mimic the real pipeline end to end: uint8 storage + the full
-        # CIFAR augment stack (loader-throughput bench fidelity).
+        # augment stack (loader-throughput bench fidelity).
         x = (x * 255.0).astype(np.uint8)
     return x, y
 
@@ -145,13 +162,23 @@ class DataLoader:
     Equivalent in role to the reference's vendored DataLoader
     (``my_data_loader.py:254-319``) including its persistent-iterator
     ``next_batch`` accessor, but thread+numpy based.
+
+    ``workers`` > 1 assembles batches on a thread pool (the hot paths —
+    native crop/RRC kernels and numpy gathers — release or don't hold the
+    GIL) with a bounded in-flight window and in-order delivery; 0 means
+    one worker per CPU. RRC augmentation is bit-identical at ANY worker
+    count (counter-based rects, augment.rrc_params); crop/flip datasets
+    switch from one sequential rng stream to per-batch derived streams
+    when workers > 1, so their draws differ from the single-worker path
+    (still deterministic in (seed, epoch, host, batch)).
     """
 
     def __init__(self, x: np.ndarray, y: np.ndarray, batch_size: int,
                  dataset: str = "synthetic", train: bool = True,
                  shuffle: Optional[bool] = None, seed: int = 0,
                  host_id: int = 0, num_hosts: int = 1, prefetch: int = 2,
-                 drop_last: bool = True, device_normalize: bool = False):
+                 drop_last: bool = True, device_normalize: bool = False,
+                 workers: int = 1):
         assert len(x) == len(y)
         self.x, self.y = x, y
         self.dataset = dataset
@@ -165,6 +192,8 @@ class DataLoader:
         self.host_id, self.num_hosts = host_id, num_hosts
         self.prefetch = prefetch
         self.drop_last = drop_last
+        self.workers = max(1, workers if workers > 0
+                           else (os.cpu_count() or 1))
         if batch_size % num_hosts != 0:
             raise ValueError(f"global batch {batch_size} not divisible by {num_hosts} hosts")
         self.local_batch = batch_size // num_hosts
@@ -183,6 +212,14 @@ class DataLoader:
         if train and dataset in augment.CROP_STACKS:
             pad, mode = augment.CROP_STACKS[dataset]
             self._padded = _prepad_shared(x, pad, mode)
+        # RRC datasets: storage is decode-sized (e.g. 256px), the loader
+        # produces the model-facing shape — RRC on train batches,
+        # deterministic center crop on eval batches.
+        self._rrc = augment.RRC_STACKS.get(dataset) if train else None
+        if dataset in DATASET_SHAPES:
+            self._out_h, self._out_w, _ = sample_shape(dataset)
+        else:
+            self._out_h, self._out_w = x.shape[1], x.shape[2]
         self._epoch_iter = None
         self._epoch = 0
 
@@ -200,12 +237,59 @@ class DataLoader:
         lo = self.host_id * self.shard_size
         return idx[lo:lo + self.shard_size]
 
+    def _assemble(self, b: int, order: np.ndarray, epoch: int,
+                  aug_rng) -> Tuple[np.ndarray, np.ndarray]:
+        """Assemble local batch ``b`` of one epoch — the unit of work both
+        the single prefetch thread and the worker pool run."""
+        sel = order[b * self.local_batch:(b + 1) * self.local_batch]
+        norm_out = not self.device_normalize
+        if self._rrc is not None:
+            # ImageNet-geometry RRC straight from the decode-sized store.
+            # The rect/flip rng is COUNTER-based: counter = epoch * N + sel
+            # depends only on (epoch, sample), so any worker producing any
+            # batch yields the same bytes — no rng stream to sequence.
+            scale, ratio = self._rrc
+            counters = (np.uint64(epoch) * np.uint64(len(self.x))
+                        + sel.astype(np.uint64))
+            xb = augment.random_resized_crop(
+                self.x, sel, counters, self.seed,
+                self._out_h, self._out_w, scale, ratio)
+            if norm_out:
+                mean_std = augment.norm_constants_for(self.dataset)
+                if mean_std is not None:
+                    xb = augment.normalize(xb, *mean_std)
+        elif self._padded is not None:
+            # One-pass gather+crop+flip from the pre-padded store;
+            # bit-identical to the composed path for a given aug_rng state
+            # (same draw order).
+            xb = augment.crop_flip_prepadded(
+                self._padded, sel, aug_rng, self._out_h, self._out_w)
+            if norm_out:
+                mean_std = augment.norm_constants_for(self.dataset)
+                if mean_std is not None:
+                    xb = augment.normalize(xb, *mean_std)
+        elif self.train:
+            xb = augment.augment_train(self.x[sel], self.dataset, aug_rng,
+                                       normalize_out=norm_out)
+        else:
+            xb = self.x[sel]
+            if self.dataset in augment.RRC_STACKS:
+                # Eval geometry for RRC datasets: deterministic center crop
+                # from the decode-sized store to the model shape.
+                xb = augment.center_crop(xb, self._out_h, self._out_w)
+            xb = augment.transform_test(xb, self.dataset,
+                                        normalize_out=norm_out)
+        return xb, self.y[sel]
+
     def epoch(self, epoch: int) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
         """Yield (x, y) local batches for one epoch, prefetched."""
         order = self._epoch_order(epoch)
+        n = len(self)
+        if self.workers > 1:
+            yield from self._epoch_pool(order, epoch, n)
+            return
         aug_rng = np.random.default_rng((self.seed, epoch, self.host_id, 7))
         q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
-        n = len(self)
         abandoned = threading.Event()
 
         def _put(item) -> bool:
@@ -219,31 +303,10 @@ class DataLoader:
                     continue
             return False
 
-        h, w = self.x.shape[1], self.x.shape[2]
-
         def produce():
             try:
                 for b in range(n):
-                    sel = order[b * self.local_batch:(b + 1) * self.local_batch]
-                    norm_out = not self.device_normalize
-                    if self._padded is not None:
-                        # One-pass gather+crop+flip from the pre-padded
-                        # store; bit-identical to the composed path for a
-                        # given aug_rng state (same draw order).
-                        xb = augment.crop_flip_prepadded(
-                            self._padded, sel, aug_rng, h, w)
-                        if norm_out:
-                            mean_std = augment.norm_constants_for(self.dataset)
-                            if mean_std is not None:
-                                xb = augment.normalize(xb, *mean_std)
-                    elif self.train:
-                        xb = augment.augment_train(self.x[sel], self.dataset,
-                                                   aug_rng,
-                                                   normalize_out=norm_out)
-                    else:
-                        xb = augment.transform_test(self.x[sel], self.dataset,
-                                                    normalize_out=norm_out)
-                    if not _put((xb, self.y[sel])):
+                    if not _put(self._assemble(b, order, epoch, aug_rng)):
                         return
                 _put(None)
             except BaseException as e:  # propagate into the consumer
@@ -261,6 +324,71 @@ class DataLoader:
                 yield item
         finally:
             abandoned.set()
+
+    def _epoch_pool(self, order: np.ndarray, epoch: int,
+                    n: int) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        """Multi-worker epoch: ``workers`` threads claim batch indices from
+        a shared counter, assemble concurrently (the kernels drop the GIL),
+        and park results in a completed-batch buffer the consumer drains IN
+        ORDER. The claim window is bounded (double buffering generalized:
+        at most prefetch + workers batches live beyond the consumer), so a
+        slow consumer can't make the pool run ahead unboundedly. Worker
+        exceptions propagate to the consumer; abandoning the generator
+        (early exit) releases all workers promptly."""
+        window = self.prefetch + self.workers
+        cv = threading.Condition()
+        state = {"claim": 0, "emit": 0, "abandoned": False, "error": None}
+        done: dict = {}
+
+        def work():
+            while True:
+                with cv:
+                    while (state["claim"] - state["emit"] >= window
+                           and not state["abandoned"]
+                           and state["error"] is None):
+                        cv.wait()
+                    if (state["abandoned"] or state["error"] is not None
+                            or state["claim"] >= n):
+                        return
+                    b = state["claim"]
+                    state["claim"] += 1
+                try:
+                    # Per-batch derived stream: any worker can produce any
+                    # batch without coordinating rng state. (The RRC path
+                    # ignores this rng entirely — counters cover it.)
+                    rng = np.random.default_rng(
+                        (self.seed, epoch, self.host_id, 7, b))
+                    item = self._assemble(b, order, epoch, rng)
+                except BaseException as e:
+                    with cv:
+                        state["error"] = e
+                        cv.notify_all()
+                    return
+                with cv:
+                    done[b] = item
+                    cv.notify_all()
+
+        threads = [threading.Thread(target=work, daemon=True)
+                   for _ in range(min(self.workers, max(n, 1)))]
+        for t in threads:
+            t.start()
+        try:
+            for b in range(n):
+                with cv:
+                    while b not in done and state["error"] is None:
+                        cv.wait()
+                    if state["error"] is not None:
+                        raise state["error"]
+                    item = done.pop(b)
+                    state["emit"] = b + 1
+                    cv.notify_all()
+                yield item
+        finally:
+            with cv:
+                state["abandoned"] = True
+                cv.notify_all()
+            for t in threads:
+                t.join(timeout=5.0)
 
     def next_batch(self):
         """Persistent-iterator accessor (reference ``my_data_loader.py:310-319``):
@@ -290,7 +418,9 @@ def prepare_data(cfg, host_id: int = 0, num_hosts: int = 1,
                            download=download, seed=cfg.seed)
     train = DataLoader(xtr, ytr, cfg.batch_size, cfg.dataset, train=True,
                        seed=cfg.seed, host_id=host_id, num_hosts=num_hosts,
-                       device_normalize=dev_norm)
+                       device_normalize=dev_norm,
+                       workers=getattr(cfg, "loader_workers", 1))
+    # Eval batches skip augmentation — the single prefetch thread keeps up.
     test = DataLoader(xte, yte, cfg.test_batch_size, cfg.dataset, train=False,
                       shuffle=False, seed=cfg.seed, drop_last=False,
                       device_normalize=dev_norm)

@@ -47,9 +47,10 @@ def build_model(model_name: str, num_classes: int = 10,
                 conv_impl: str = "xla") -> Any:
     """Name -> Flax module (reference: ``util.py:8-19`` build_model).
 
-    ``conv_impl="pallas"`` swaps the stride-1 3x3 convs of the ResNet and
-    VGG families for the Pallas prototype (ops/pallas_conv.py); other
-    families (LeNet's 5x5s) ignore it.
+    ``conv_impl="pallas"`` / ``"pallas_im2col"`` swap the stride-1 3x3
+    convs of the ResNet and VGG families for the Pallas prototype
+    (ops/pallas_conv.py; the suffix picks the MXU schedule, see
+    resnet.pallas_variant); other families (LeNet's 5x5s) ignore it.
     """
     if isinstance(compute_dtype, str):
         compute_dtype = _DTYPES[compute_dtype]

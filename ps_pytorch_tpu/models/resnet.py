@@ -50,11 +50,21 @@ class PallasConv3x3(nn.Module):
         return out
 
 
+def pallas_variant(conv_impl: str) -> str:
+    """MXU schedule for a ``pallas*`` conv_impl: ``pallas`` -> taps9,
+    ``pallas_im2col`` -> im2col. One mapping for ResNet and VGG, so an
+    im2col schedule accepted by the A/B row is adoptable from config alone
+    (ADVICE r5 #4)."""
+    return "im2col" if conv_impl == "pallas_im2col" else "taps9"
+
+
 def _conv3(planes, dtype, conv_impl, name=None):
     """The 3x3 stride-1 conv used everywhere in the CIFAR ResNets: XLA by
-    default; the Pallas path when the A/B accepted it for this geometry."""
-    if conv_impl == "pallas":
-        return PallasConv3x3(planes, dtype=dtype, name=name)
+    default; the Pallas path (either MXU schedule) when the A/B accepted
+    it for this geometry."""
+    if conv_impl.startswith("pallas"):
+        return PallasConv3x3(planes, dtype=dtype, name=name,
+                             variant=pallas_variant(conv_impl))
     return Conv(planes, (3, 3), padding=1, dtype=dtype, name=name)
 
 

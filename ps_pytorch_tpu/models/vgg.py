@@ -15,7 +15,7 @@ import flax.linen as nn
 import jax.numpy as jnp
 from jax.nn.initializers import variance_scaling
 
-from ps_pytorch_tpu.models.resnet import PallasConv3x3
+from ps_pytorch_tpu.models.resnet import PallasConv3x3, pallas_variant
 
 # He-style init over fan_out = k*k*out_channels, matching vgg.py:32-36.
 conv_init = variance_scaling(2.0, "fan_out", "normal")
@@ -35,8 +35,9 @@ class VGG(nn.Module):
     batch_norm: bool = False
     num_classes: int = 10
     dtype: Any = jnp.float32
-    conv_impl: str = "xla"   # "pallas": ops/pallas_conv for every conv
-    # past the stem (the 3-channel input conv starves the lane dim)
+    conv_impl: str = "xla"   # "pallas"/"pallas_im2col": ops/pallas_conv
+    # for every conv past the stem (the 3-channel input conv starves the
+    # lane dim); the suffix picks the MXU schedule (resnet.pallas_variant)
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -50,9 +51,10 @@ class VGG(nn.Module):
             # Conv names explicit and equal to the legacy flax auto-names
             # (same reasoning as resnet.BasicBlock): xla/pallas
             # checkpoints stay interchangeable.
-            if self.conv_impl == "pallas" and x.shape[-1] >= 8:
+            if self.conv_impl.startswith("pallas") and x.shape[-1] >= 8:
                 x = PallasConv3x3(v, dtype=self.dtype, use_bias=True,
                                   kernel_init=conv_init,
+                                  variant=pallas_variant(self.conv_impl),
                                   name=f"Conv_{k}")(x)
             else:
                 x = nn.Conv(v, (3, 3), padding=1, dtype=self.dtype,

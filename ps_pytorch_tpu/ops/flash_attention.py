@@ -43,6 +43,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+if not hasattr(pltpu, "CompilerParams"):
+    # Older jax spells it TPUCompilerParams; same fields.
+    pltpu.CompilerParams = pltpu.TPUCompilerParams
+
 from ps_pytorch_tpu.ops._backend import interpret_default as _interpret_default
 
 NEG_INF = -1e30

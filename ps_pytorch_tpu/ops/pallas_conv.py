@@ -98,14 +98,30 @@ def _conv3x3(x, w, block_n, interpret, variant):
     )(xp, w2)
 
 
+def effective_block_n(n: int, block_n: int = 4,
+                      variant: str = "taps9") -> int:
+    """The batch tile ``conv3x3`` ACTUALLY runs for a requested block_n:
+    im2col materializes [Bt*H*W, 9C] patches in VMEM, so its tile is halved
+    to stay under the double-buffering budget — halved BEFORE the
+    divisibility shrink (halving afterwards could yield a block_n that no
+    longer divides N, and grid = N // block_n would then silently leave the
+    tail batch rows unwritten). Exposed so the bench A/B records the tile
+    each variant really used (ADVICE r5 #3) with one source of truth."""
+    if variant == "im2col":
+        block_n = max(block_n // 2, 1)
+    while n % block_n:
+        block_n //= 2
+    return max(block_n, 1)
+
+
 def conv3x3(x, w, *, block_n: int = 4, variant: str = "taps9",
             interpret: Optional[bool] = None) -> jax.Array:
     """NHWC 3x3 stride-1 SAME conv. x [N,H,W,C] @ w [3,3,C,Co] -> [N,H,W,Co].
 
-    ``block_n`` is the batch tile per grid step (auto-shrunk to divide N);
-    ``variant`` picks the MXU schedule (see _conv_kernel). f32 accumulation
-    regardless of dtype — matches
-    ``lax.conv_general_dilated(..., preferred_element_type=f32)``.
+    ``block_n`` is the batch tile per grid step (auto-shrunk to divide N,
+    halved first for im2col — see effective_block_n); ``variant`` picks the
+    MXU schedule (see _conv_kernel). f32 accumulation regardless of dtype —
+    matches ``lax.conv_general_dilated(..., preferred_element_type=f32)``.
     """
     if x.ndim != 4 or w.shape[:2] != (3, 3) or w.shape[2] != x.shape[-1]:
         raise ValueError(f"need x [N,H,W,C] and w [3,3,C,Co]; got "
@@ -114,17 +130,8 @@ def conv3x3(x, w, *, block_n: int = 4, variant: str = "taps9",
         raise ValueError(f"unknown variant {variant!r}")
     if interpret is None:
         interpret = _interpret_default()
-    # im2col materializes [Bt*H*W, 9C] patches in VMEM — halve the batch
-    # tile to keep the block under the double-buffering budget. Halve
-    # BEFORE the divisibility shrink: halving afterwards could yield a
-    # block_n that no longer divides N, and grid = N // block_n would then
-    # silently leave the tail batch rows unwritten.
-    if variant == "im2col":
-        block_n = max(block_n // 2, 1)
-    n = x.shape[0]
-    while n % block_n:
-        block_n //= 2
-    return _conv3x3(x, w, max(block_n, 1), interpret, variant)
+    return _conv3x3(x, w, effective_block_n(x.shape[0], block_n, variant),
+                    interpret, variant)
 
 
 def conv3x3_input_grad(g, w, *, block_n: int = 4, variant: str = "taps9",

@@ -41,7 +41,13 @@ def _apply_platform_overrides() -> None:
         jax.config.update("jax_platforms", platform)
     n_local = os.environ.get(ENV_LOCAL_DEVICES)
     if n_local:
-        jax.config.update("jax_num_cpu_devices", int(n_local))
+        try:
+            jax.config.update("jax_num_cpu_devices", int(n_local))
+        except AttributeError:  # older jax: fall back to the XLA flag
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "")
+                + f" --xla_force_host_platform_device_count={int(n_local)}"
+            ).strip()
 
 
 def initialize_from_env() -> bool:

@@ -147,6 +147,30 @@ def collect(repo: str):
         p = _newest(pat, repo, exclude=excl)
         if p:
             add(label, p, _suite_summary(_load(p)))
+    p = _newest("BENCH_HOST_r[0-9]*.json", repo)
+    if p:
+        # Quiet-host loader evidence (JSON-lines of input_pipeline* rows) —
+        # what PERF.md §5's feeding-budget table cites (ADVICE r5 #2). The
+        # headline value prefers the augmented ImageNet row (the real train
+        # path) over the augment-free ones.
+        rows = _load(p)
+        if isinstance(rows, dict):        # single-row file parses as dict
+            rows = [rows]
+        rows = [r for r in rows if isinstance(r, dict)]
+        errors = [r.get("config", "?") for r in rows if "error" in r]
+        loaders = [r for r in rows if "loader_images_per_sec" in r]
+        best = next(
+            (r for r in loaders
+             if r.get("config") == "input_pipeline_imagenet_augmented"),
+            loaders[0] if loaders else None)
+        add("host pipeline", p, {
+            "rows": len(rows),
+            "value": best["loader_images_per_sec"] if best else None,
+            "unit": "img/s ({})".format(
+                best.get("config", "?") if best else "?"),
+            "platform": "host",
+            "ok": bool(loaders) and not errors,
+            "errors": errors})
     for pat, label, key in (
             ("ACCURACY_r[0-9]*.json", "accuracy CNN", "prec1"),
             ("ACCURACY_LM_r[0-9]*.json", "accuracy LM", "perplexity"),

@@ -34,5 +34,19 @@ def load_native_lib(so_name: str,
             lib = ctypes.CDLL(so)
             configure(lib)
             return lib
+        except AttributeError:
+            # Stale build: the .so predates a symbol the caller now
+            # configures (e.g. a loader built before psl_rrc_batch).
+            # Force-rebuild once and retry; unlink first so a failed make
+            # cannot leave the stale binary to be found again next run.
+            try:
+                os.unlink(so)
+                subprocess.run(["make", "-B", "-C", make_dir, so_name],
+                               capture_output=True, timeout=120, check=True)
+                lib = ctypes.CDLL(so)
+                configure(lib)
+                return lib
+            except Exception:
+                return None
         except OSError:
             return None

@@ -15,7 +15,12 @@ import jax  # noqa: E402
 # The axon TPU plugin (sitecustomize) force-sets jax_platforms="axon,cpu";
 # override it back to CPU-only before any backend initializes.
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # Older jax without the option: the XLA_FLAGS line above already
+    # forces 8 host-platform devices.
+    pass
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -258,3 +258,20 @@ def test_mnist_idx_parser():
 def test_cifar10_pickle_parser():
     x, y = load_arrays("Cifar10", "./data", train=False)
     assert x.shape == (10000, 32, 32, 3) and x.dtype == np.uint8
+
+
+def test_digits_multiworker_loader_matches_single():
+    """Real-scan data through the worker pool: Digits has no crop/RRC
+    stack, so the multi-worker epoch must be bit-identical to the
+    single-worker one (the normalize path has no rng at all)."""
+    from ps_pytorch_tpu.data.datasets import DataLoader
+
+    x, y = load_arrays("Digits", train=True)
+    single = DataLoader(x, y, 128, "Digits", train=True, seed=5)
+    pooled = DataLoader(x, y, 128, "Digits", train=True, seed=5, workers=4)
+    b1 = list(single.epoch(0))
+    b4 = list(pooled.epoch(0))
+    assert len(b1) == len(b4) == len(single)
+    for (xa, ya), (xb, yb) in zip(b1, b4):
+        np.testing.assert_array_equal(xa, xb)
+        np.testing.assert_array_equal(ya, yb)

@@ -51,12 +51,16 @@ set -x
 # the persistent compile cache from rounds 3-4. A short window must land
 # the round-4-lost evidence (convergence fix, quantizer split, ladder)
 # before the multi-hour prime pass risks outliving the tunnel.
-# Row budget 280 s x 15 rows = 4200 s < the 4500 s stage ceiling: even the
-# all-rows-degraded case exhausts row kills (children expiring on their own
-# timers) before the outer timeout could SIGTERM a child mid-RPC (protocol
-# note 5). Warm rows need seconds; 280 s absorbs >10x dispatch-tax slowdown.
-timeout 4500 python bench_suite.py --steps 20 --isolate --row-timeout 280 \
-    --configs lenet_mnist_single,lenet_mnist_dp,resnet18_cifar10_dp,vgg11_cifar100_kofn,resnet50_imagenet,resnet18_fused_sgd,resnet18_zero1,resnet18_remat,resnet18_b2048,resnet18_b4096,int8_quantizer,lenet_convergence,resnet18_async_2slice,input_pipeline,input_pipeline_imagenet \
+# The stage ceiling is DERIVED from the row count (rows x budget + slack):
+# even the all-rows-degraded case exhausts row kills (children expiring on
+# their own timers) before the outer timeout could SIGTERM a child mid-RPC
+# (protocol note 5). Warm rows need seconds; 280 s absorbs >10x
+# dispatch-tax slowdown.
+QUICK_CONFIGS=lenet_mnist_single,lenet_mnist_dp,resnet18_cifar10_dp,vgg11_cifar100_kofn,resnet50_imagenet,resnet18_fused_sgd,resnet18_zero1,resnet18_remat,resnet18_b2048,resnet18_b4096,int8_quantizer,lenet_convergence,resnet18_async_2slice,input_pipeline,input_pipeline_imagenet,input_pipeline_imagenet_augmented
+QUICK_ROWS=$(echo "$QUICK_CONFIGS" | tr ',' '\n' | wc -l)
+timeout $((QUICK_ROWS * 280 + 300)) \
+    python bench_suite.py --steps 20 --isolate --row-timeout 280 \
+    --configs "$QUICK_CONFIGS" \
     --markdown "BENCH_SUITE_${ROUND}_quick.md" \
     > "BENCH_SUITE_${ROUND}_quick.json.new" 2>"/tmp/suite_quick_${ROUND}.log"
 QUICK_RC=$?
@@ -94,10 +98,14 @@ for cfg in transformer_lm_2k transformer_lm_2k_remat transformer_lm_2k_flash \
 done
 
 # ---- 3. full suite, warm cache. Invariant: outer ceiling > rows x row
-# budget (26 x 500 = 13000 < 14000) so children always expire on their
-# own timers, never SIGTERMed mid-RPC; 500 s/row is generous warm (all
-# cold compiles were primed in stage 2). ----
-timeout 14000 python bench_suite.py --steps 20 --isolate --row-timeout 500 \
+# budget, DERIVED from len(bench_suite.CONFIGS) so a new row can never
+# silently re-stale a hardcoded product (ADVICE r5 #1: "26 x 500 = 13000"
+# was already wrong at 25 rows). Children always expire on their own
+# timers, never SIGTERMed mid-RPC; 500 s/row is generous warm (all cold
+# compiles were primed in stage 2). ----
+SUITE_ROWS=$(python -c "import bench_suite; print(len(bench_suite.CONFIGS))") || exit 9
+timeout $((SUITE_ROWS * 500 + 1000)) \
+    python bench_suite.py --steps 20 --isolate --row-timeout 500 \
     --markdown "BENCH_SUITE_${ROUND}.md" \
     > "BENCH_SUITE_${ROUND}.json.new" 2>"/tmp/suite_err_${ROUND}.log"
 SUITE_RC=$?
