@@ -87,25 +87,41 @@ def _build(network, dataset, batch, *, mode="sync", num_aggregate=0,
     return state, step_fn, x, y, jnp.asarray(mask)
 
 
-def time_steps(state, step_fn, x, y, mask, steps=20, warmup=3):
+def time_steps(state, step_fn, x, y, mask, steps=20, warmup=3, tracer=None):
+    """Mean seconds/step (float — bench.py depends on this return type).
+    ``tracer``: optional telemetry Tracer; when given, the timed loop's
+    dispatch and final sync are recorded as spans so suite rows can carry
+    a per-phase breakdown."""
+    from contextlib import nullcontext
+
+    def span(name, i):
+        return (tracer.span(name, step=i) if tracer is not None
+                else nullcontext())
+
     for i in range(warmup):
         state, metrics = step_fn(state, x, y, mask, jax.random.key(i))
     _ = float(metrics["loss"])
     jax.block_until_ready(state.params)
     t0 = time.perf_counter()
     for i in range(steps):
-        state, metrics = step_fn(state, x, y, mask, jax.random.key(100 + i))
-    jax.block_until_ready(state.params)
-    _ = float(metrics["loss"])
+        with span("host_dispatch", i + 1):
+            state, metrics = step_fn(state, x, y, mask, jax.random.key(100 + i))
+    with span("device_sync", steps):
+        jax.block_until_ready(state.params)
+        _ = float(metrics["loss"])
     return (time.perf_counter() - t0) / steps
 
 
 def bench_throughput(name, network, dataset, per_device_batch, steps, **kw):
+    from ps_pytorch_tpu.telemetry import Tracer
+
     n_dev = kw.pop("n_devices", None) or len(jax.devices())
     batch = per_device_batch * n_dev
     state, step_fn, x, y, mask = _build(network, dataset, batch,
                                         n_devices=n_dev, **kw)
-    sec_per_step = time_steps(state, step_fn, x, y, mask, steps=steps)
+    tracer = Tracer()
+    sec_per_step = time_steps(state, step_fn, x, y, mask, steps=steps,
+                              tracer=tracer)
     ips = batch / sec_per_step
     base = BASELINES.get(name)
     return {"config": name, "network": network, "dataset": dataset,
@@ -113,6 +129,9 @@ def bench_throughput(name, network, dataset, per_device_batch, steps, **kw):
             "devices": n_dev, "global_batch": batch,
             "sec_per_step": round(sec_per_step, 5),
             "images_per_sec": round(ips, 1),
+            # Host-side phase accounting for the timed window (telemetry
+            # tracer): dispatch vs trailing-sync seconds, with counts.
+            "phases": tracer.totals(),
             "vs_baseline": round(ips / base, 2) if base else None,
             # The reference published only relative speedups; the absolute
             # per-node rates under BASELINES are estimates (see comment

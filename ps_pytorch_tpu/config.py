@@ -102,11 +102,13 @@ class TrainConfig:
     inject_step_delay: float = 0.0   # seconds of artificial per-step delay
     inject_delay_process: int = -1   # process_index to slow; -1 = nobody
 
-    # -- logging / profiling --
+    # -- logging / profiling / telemetry --
     log_every: int = 1
-    metrics_file: str = ""          # optional JSONL metrics sink ("" = stdout only)
+    metrics_file: str = ""          # optional JSONL metrics sink ("" = stdout only; multi-process runs suffix .p<k> per host)
     profile_dir: str = ""           # jax.profiler trace output ("" = off; SURVEY §5.1)
     profile_steps: str = "10-12"    # inclusive step range to trace, "start-end"
+    trace_file: str = ""            # host-side Chrome trace_event JSON ("" = off; telemetry/trace.py, opens in Perfetto)
+    timeline_file: str = ""         # leader-merged per-replica step timeline JSONL ("" = <metrics_file>.timeline when multi-process; telemetry/aggregate.py)
 
     def __post_init__(self) -> None:
         if self.num_classes == 0:

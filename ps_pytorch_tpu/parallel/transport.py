@@ -37,6 +37,7 @@ import jax
 import numpy as np
 
 from ps_pytorch_tpu.compression import g_compress, g_decompress
+from ps_pytorch_tpu.telemetry.trace import span as _span
 
 _CHUNK = 1 << 18  # 256 KiB of base64 text per KV value
 _RAW_MAGIC = b"NPYRAW0:"
@@ -87,25 +88,26 @@ class KVPytreeChannel:
 
     # ---- writer side ----
     def publish(self, version: int, tree: Any, meta: Optional[dict] = None) -> None:
-        leaves, treedef = jax.tree.flatten(tree)
-        if treedef != self.treedef:
-            raise ValueError("published tree structure != channel template")
-        chunk_counts = []
-        nbytes = 0
-        for l_idx, leaf in enumerate(leaves):
-            chunks = _encode_leaf(leaf, self.level, self.codec)
-            chunk_counts.append(len(chunks))
-            nbytes += sum(len(c) for c in chunks)
-            for c_idx, c in enumerate(chunks):
-                self.kv.set(f"{self.prefix}/{version}/{l_idx}/{c_idx}", c)
-        self.bytes_out += nbytes
-        self.last_publish_bytes = nbytes
-        self.publishes += 1
-        self.kv.set(f"{self.prefix}/{version}/meta",
-                    json.dumps({**(meta or {}), "chunks": chunk_counts}))
-        # Pointer moves only after the payload is fully visible.
-        self.kv.set(f"{self.prefix}/ver", str(version))
-        self._gc(version - 2)
+        with _span("wire_publish", channel=self.prefix, version=version):
+            leaves, treedef = jax.tree.flatten(tree)
+            if treedef != self.treedef:
+                raise ValueError("published tree structure != channel template")
+            chunk_counts = []
+            nbytes = 0
+            for l_idx, leaf in enumerate(leaves):
+                chunks = _encode_leaf(leaf, self.level, self.codec)
+                chunk_counts.append(len(chunks))
+                nbytes += sum(len(c) for c in chunks)
+                for c_idx, c in enumerate(chunks):
+                    self.kv.set(f"{self.prefix}/{version}/{l_idx}/{c_idx}", c)
+            self.bytes_out += nbytes
+            self.last_publish_bytes = nbytes
+            self.publishes += 1
+            self.kv.set(f"{self.prefix}/{version}/meta",
+                        json.dumps({**(meta or {}), "chunks": chunk_counts}))
+            # Pointer moves only after the payload is fully visible.
+            self.kv.set(f"{self.prefix}/ver", str(version))
+            self._gc(version - 2)
 
     def _gc(self, version: int) -> None:
         if version < 0:
@@ -128,23 +130,24 @@ class KVPytreeChannel:
         """-> (version, tree, meta) or None if nothing published / already
         GC'd. Reading the pointer's current target is race-free (see module
         docstring)."""
-        if version is None:
-            version = self.latest_version()
+        with _span("wire_read", channel=self.prefix):
             if version is None:
+                version = self.latest_version()
+                if version is None:
+                    return None
+            meta_s = self.kv.get(f"{self.prefix}/{version}/meta")
+            if meta_s is None:
                 return None
-        meta_s = self.kv.get(f"{self.prefix}/{version}/meta")
-        if meta_s is None:
-            return None
-        meta = json.loads(meta_s)
-        leaves = []
-        for l_idx, n in enumerate(meta["chunks"]):
-            chunks = [self.kv.get(f"{self.prefix}/{version}/{l_idx}/{c_idx}")
-                      for c_idx in range(n)]
-            if any(c is None for c in chunks):
-                return None  # concurrently GC'd (reader was very stale)
-            self.bytes_in += sum(len(c) for c in chunks)
-            leaves.append(_decode_leaf(chunks))
-        return version, jax.tree.unflatten(self.treedef, leaves), meta
+            meta = json.loads(meta_s)
+            leaves = []
+            for l_idx, n in enumerate(meta["chunks"]):
+                chunks = [self.kv.get(f"{self.prefix}/{version}/{l_idx}/{c_idx}")
+                          for c_idx in range(n)]
+                if any(c is None for c in chunks):
+                    return None  # concurrently GC'd (reader was very stale)
+                self.bytes_in += sum(len(c) for c in chunks)
+                leaves.append(_decode_leaf(chunks))
+            return version, jax.tree.unflatten(self.treedef, leaves), meta
 
 
 class KVGradientTransport:

@@ -42,6 +42,7 @@ from ps_pytorch_tpu.runtime import checkpoint as ckpt
 from ps_pytorch_tpu.runtime.coordinator import DistributedKV, KVStore
 from ps_pytorch_tpu.runtime.metrics import MetricsLogger
 from ps_pytorch_tpu.runtime.multislice import make_slice_grad_fn
+from ps_pytorch_tpu.telemetry import Tracer, set_default_tracer
 
 
 class AsyncTrainer:
@@ -127,7 +128,14 @@ class AsyncTrainer:
                                       seed=cfg.seed, drop_last=False,
                                       device_normalize=dev_norm)
 
-        self.metrics = MetricsLogger(cfg.metrics_file, cfg.log_every)
+        self.metrics = MetricsLogger(cfg.metrics_file, cfg.log_every,
+                                     process_index=self.pid,
+                                     num_processes=self.n)
+        # Ambient tracer: the wire_publish/wire_read spans inside
+        # transport.py land here, so the Chrome trace shows what each
+        # process's DCN legs cost relative to its compute.
+        self.tracer = Tracer(pid=self.pid)
+        self._prev_tracer = set_default_tracer(self.tracer)
         self.last_publish_s = 0.0
         self.version = 0        # canonical PS step (leader-owned)
         self.applied = 0
@@ -221,16 +229,19 @@ class AsyncTrainer:
         self.last_publish_s = time.monotonic() - t0
 
     def _compute_and_submit(self, version_used: int) -> dict:
-        x, y = self.train_loader.next_batch()
-        grads, m, new_bs = self.grad_fn(
-            self.params, self._bs, jnp.asarray(x), jnp.asarray(y),
-            jax.random.PRNGKey(self.cfg.seed * 7919
-                               + self._seq * 13 + self.pid))
+        with self.tracer.span("data_wait", step=self._seq + 1):
+            x, y = self.train_loader.next_batch()
+        with self.tracer.span("host_dispatch", step=self._seq + 1):
+            grads, m, new_bs = self.grad_fn(
+                self.params, self._bs, jnp.asarray(x), jnp.asarray(y),
+                jax.random.PRNGKey(self.cfg.seed * 7919
+                                   + self._seq * 13 + self.pid))
         self._bs = new_bs
         self._seq += 1
         self.transport.submit_grads(self.pid, self._seq, version_used,
                                     self._encode_grads(grads))
-        return {"loss": float(m["loss"]), "acc": float(m["accuracy"])}
+        with self.tracer.span("device_sync", step=self._seq):
+            return {"loss": float(m["loss"]), "acc": float(m["accuracy"])}
 
     def _leader_apply(self) -> int:
         """Pool new wire contributions and apply at most one update.
@@ -286,6 +297,22 @@ class AsyncTrainer:
         # Safety valve for followers if the leader dies before set_done:
         # bounded loop, generous multiple of the canonical target.
         max_own = cfg.max_steps * 50 + 100
+        try:
+            self._train_loop(cfg, my_version, own_steps, max_own)
+        finally:
+            # Sinks close on any exit (a follower TimeoutError must not
+            # leak the JSONL handle or drop the trace).
+            self.metrics.close()
+            if cfg.trace_file:
+                path = cfg.trace_file
+                if self.pid > 0:
+                    path = f"{path}.p{self.pid}"
+                self.tracer.write_chrome_trace(path)
+            set_default_tracer(self._prev_tracer)
+        return self.params
+
+    def _train_loop(self, cfg, my_version: int, own_steps: int,
+                    max_own: int) -> None:
         while own_steps < max_own:
             t0 = time.monotonic()
             done = self.transport.done()
@@ -326,8 +353,6 @@ class AsyncTrainer:
             # publish_every (evaluate() and late followers read it).
             self._publish_canonical()
             self.transport.set_done(self.version)
-        self.metrics.close()
-        return self.params
 
     @property
     def fetch_every(self) -> int:

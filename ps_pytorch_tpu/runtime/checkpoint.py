@@ -27,6 +27,8 @@ import jax
 import numpy as np
 from flax import serialization
 
+from ps_pytorch_tpu.telemetry.trace import span as _span
+
 _STEP_RE = re.compile(r"^model_step_(\d+)$")
 
 
@@ -38,6 +40,14 @@ def save_checkpoint(train_dir: str, step: int, state: Any,
                     config_json: str = "{}", compress: bool = False,
                     codec_level: int = 3, extra_meta: Optional[dict] = None) -> str:
     """Atomically write train_dir/model_step_<step>. Returns the final path."""
+    with _span("checkpoint_write", step=step):
+        return _save_checkpoint(train_dir, step, state, config_json,
+                                compress, codec_level, extra_meta)
+
+
+def _save_checkpoint(train_dir: str, step: int, state: Any,
+                     config_json: str, compress: bool,
+                     codec_level: int, extra_meta: Optional[dict]) -> str:
     os.makedirs(train_dir, exist_ok=True)
     state = jax.device_get(state)
     blob = serialization.to_bytes(state)
@@ -82,6 +92,12 @@ def load_checkpoint(train_dir: str, step: int, target: Any,
     flax surfaces layout changes (from_state_dict raises on key
     differences), so this is the one hook point old checkpoints funnel
     through."""
+    with _span("checkpoint_load", step=step):
+        return _load_checkpoint(train_dir, step, target, migrate)
+
+
+def _load_checkpoint(train_dir: str, step: int, target: Any,
+                     migrate) -> Tuple[Any, dict, str]:
     path = checkpoint_path(train_dir, step)
     with open(os.path.join(path, "meta.json")) as f:
         meta = json.load(f)

@@ -33,6 +33,8 @@ from typing import Dict, Optional
 
 import numpy as np
 
+from ps_pytorch_tpu.telemetry.trace import span as _span
+
 
 class KVStore:
     """Minimal KV interface. In-process default; DistributedKV over the JAX
@@ -167,32 +169,36 @@ class Coordinator:
         reference's tag-10 step broadcast, applied to the mask.
         """
         key = f"{self.run_id}/mask/{step}"
-        if not self.leader:
-            deadline = time.monotonic() + timeout_s
-            while True:
-                v = self.kv.get(key)
-                if v is not None:
-                    return np.asarray(json.loads(v), np.float32)
-                if time.monotonic() > deadline:
-                    raise TimeoutError(f"no mask published for step {step}")
-                time.sleep(0.002)
-        mask = self._decide_mask()
-        # Observability: one stable line whenever the decision changes (the
-        # reference's only straggler evidence was per-worker timing logs).
-        desc = json.dumps(mask.astype(int).tolist())
-        if desc != self._last_printed_mask:
-            print(f"MASK step {step} {desc}")
-            self._last_printed_mask = desc
-        self.kv.set(key, json.dumps(mask.tolist()))
-        # GC with a WIDE window, not step-2: JAX dispatch is async and
-        # followers only synchronize when metrics materialize (log_every), so
-        # a follower can lag many host-loop iterations behind the leader —
-        # deleting a mask it has not yet read would strand it in a 300 s
-        # TimeoutError (round-1 advisor, medium). Masks are ~n_replicas
-        # floats, so retaining `mask_gc_window` of them is still O(1).
-        if step >= self.mask_gc_window:
-            self.kv.delete(f"{self.run_id}/mask/{step - self.mask_gc_window}")
-        return mask
+        # Ambient span (telemetry/trace.py): on the follower this measures
+        # the mask-wait — the control-plane stall a straggling leader
+        # inflicts on everyone else — and on the leader the decide+publish.
+        with _span("coordinator_mask", step=step):
+            if not self.leader:
+                deadline = time.monotonic() + timeout_s
+                while True:
+                    v = self.kv.get(key)
+                    if v is not None:
+                        return np.asarray(json.loads(v), np.float32)
+                    if time.monotonic() > deadline:
+                        raise TimeoutError(f"no mask published for step {step}")
+                    time.sleep(0.002)
+            mask = self._decide_mask()
+            # Observability: one stable line whenever the decision changes (the
+            # reference's only straggler evidence was per-worker timing logs).
+            desc = json.dumps(mask.astype(int).tolist())
+            if desc != self._last_printed_mask:
+                print(f"MASK step {step} {desc}")
+                self._last_printed_mask = desc
+            self.kv.set(key, json.dumps(mask.tolist()))
+            # GC with a WIDE window, not step-2: JAX dispatch is async and
+            # followers only synchronize when metrics materialize (log_every), so
+            # a follower can lag many host-loop iterations behind the leader —
+            # deleting a mask it has not yet read would strand it in a 300 s
+            # TimeoutError (round-1 advisor, medium). Masks are ~n_replicas
+            # floats, so retaining `mask_gc_window` of them is still O(1).
+            if step >= self.mask_gc_window:
+                self.kv.delete(f"{self.run_id}/mask/{step - self.mask_gc_window}")
+            return mask
 
     def _decide_mask(self) -> np.ndarray:
         mask = (~self._killed).astype(np.float32)

@@ -41,6 +41,10 @@ from ps_pytorch_tpu.parallel.sp import (
 )
 from ps_pytorch_tpu.runtime import checkpoint as ckpt
 from ps_pytorch_tpu.runtime.metrics import MetricsLogger
+from ps_pytorch_tpu.telemetry import (
+    Tracer, aggregate_peak_flops, derive_step_record, set_default_tracer,
+    step_flops_of,
+)
 
 
 class LMTrainer:
@@ -170,7 +174,16 @@ class LMTrainer:
         train_stream, self.val_tokens = lm_streams(cfg)
         self.train_loader = TokenLoader(train_stream, cfg.batch_size,
                                         cfg.lm_seq_len, seed=cfg.seed)
-        self.metrics = MetricsLogger(cfg.metrics_file, cfg.log_every)
+        self.metrics = MetricsLogger(cfg.metrics_file, cfg.log_every,
+                                     process_index=jax.process_index(),
+                                     num_processes=jax.process_count())
+        # Same telemetry surface as the CNN Trainer (schema parity — the
+        # analyze tooling must read vision and LM runs identically).
+        self.tracer = Tracer(pid=jax.process_index())
+        self._prev_tracer = set_default_tracer(self.tracer)
+        self._flops_per_step: Optional[int] = None
+        self._n_chips = n
+        self._peak_per_chip = aggregate_peak_flops(devices)
         self.start_step = 0
 
     # ---- checkpoint/resume (same on-disk contract as the CNN Trainer) ----
@@ -252,31 +265,61 @@ class LMTrainer:
         if cfg.resume:
             self.maybe_resume()
         step = self.start_step
-        while step < cfg.max_steps:
-            step += 1
-            t0 = time.monotonic()
-            tokens = self.train_loader.next_batch()
-            t_data = time.monotonic() - t0
-            # Every process generates the identical shared-seed batch; the
-            # globalize places each host's shard (multi-process safe — a
-            # host-local committed array can't feed a multi-host
-            # shard_map). SP shards the SEQUENCE axis; tp/pp/ep shard the
-            # batch axis.
-            tok_g = dist.globalize_replicated(self.mesh, tokens,
-                                              spec=self._token_spec())
-            self.state, m = self.step_fn(self.state, tok_g)
-            if step % cfg.log_every == 0 or step == cfg.max_steps:
-                loss = float(m["loss"])
-                self.metrics.log_step(step, self.train_loader._epoch,
-                                      loss=loss, acc=0.0, participating=1.0,
-                                      step_time=time.monotonic() - t0,
-                                      data_time=t_data)
-            if cfg.eval_freq > 0 and step % cfg.eval_freq == 0:
-                self._checkpoint(step)
-        jax.block_until_ready(self.state.params)
-        if cfg.eval_freq > 0 and step % cfg.eval_freq != 0:
-            self._checkpoint(step)
-        self.metrics.close()
+        try:
+            while step < cfg.max_steps:
+                step += 1
+                t0 = time.monotonic()
+                with self.tracer.span("data_wait", step=step):
+                    tokens = self.train_loader.next_batch()
+                t_data = time.monotonic() - t0
+                # Every process generates the identical shared-seed batch; the
+                # globalize places each host's shard (multi-process safe — a
+                # host-local committed array can't feed a multi-host
+                # shard_map). SP shards the SEQUENCE axis; tp/pp/ep shard the
+                # batch axis.
+                tok_g = dist.globalize_replicated(self.mesh, tokens,
+                                                  spec=self._token_spec())
+                if self._flops_per_step is None:
+                    self._flops_per_step = step_flops_of(
+                        self.step_fn, self.state, tok_g) or -1
+                with self.tracer.span("host_dispatch", step=step):
+                    self.state, m = self.step_fn(self.state, tok_g)
+                # Dispatch-time wall clock: what a non-blocking iteration
+                # costs. The metrics_sync below (loss materialization) is
+                # deliberately NOT folded in, matching trainer.py.
+                t_step = time.monotonic() - t0
+                if step % cfg.log_every == 0 or step == cfg.max_steps:
+                    with self.tracer.span("metrics_sync", step=step):
+                        loss = float(m["loss"])
+                    derived = derive_step_record(
+                        step_time_s=t_step, data_time_s=t_data,
+                        examples=cfg.batch_size,
+                        tokens=cfg.batch_size * cfg.lm_seq_len,
+                        flops_per_step=(self._flops_per_step
+                                        if self._flops_per_step and
+                                        self._flops_per_step > 0 else None),
+                        peak_flops_per_chip=self._peak_per_chip,
+                        n_chips=self._n_chips)
+                    self.metrics.log_step(
+                        step, self.train_loader._epoch,
+                        loss=loss, acc=0.0, participating=1.0,
+                        step_time=t_step, data_time=t_data,
+                        phases=self.tracer.step_summary(step), **derived)
+                if cfg.eval_freq > 0 and step % cfg.eval_freq == 0:
+                    with self.tracer.span("checkpoint", step=step):
+                        self._checkpoint(step)
+            jax.block_until_ready(self.state.params)
+            if cfg.eval_freq > 0 and step % cfg.eval_freq != 0:
+                with self.tracer.span("checkpoint", step=step):
+                    self._checkpoint(step)
+        finally:
+            self.metrics.close()
+            if cfg.trace_file:
+                path = cfg.trace_file
+                if jax.process_index() > 0:
+                    path = f"{path}.p{jax.process_index()}"
+                self.tracer.write_chrome_trace(path)
+            set_default_tracer(self._prev_tracer)
         return self.state
 
     def _token_spec(self) -> P:

@@ -1,18 +1,46 @@
-"""Structured per-step metrics.
+"""Structured per-step metrics — versioned v2 schema.
 
 The reference's observability is hand-rolled wall-clock prints whose exact
 format downstream tooling regex-parses (``distributed_worker.py:169-173``,
 ``tiny_tuning_parser.py:18-20``, SURVEY §5.1). Here the schema is defined
 once: every step emits (a) one stable human-readable line and (b) optionally
 one JSON line to a metrics file. ``parse_line`` is the inverse, used by the
-analysis tooling (tools/analyze.py) and by the log-schema test — the schema
+analysis tooling (tools/analyze.py) and by the log-schema tests — the schema
 cannot drift without a test failing.
+
+Schema v2 (this file's ``SCHEMA_VERSION``) is ADDITIVE over v1: the v1
+seven-field prefix is unchanged and a v1 line still parses; v2 appends the
+utilization triple — ``mfu`` (model FLOPs utilization, telemetry/registry
+.py's one definition), ``examples_per_sec`` goodput, and
+``data_stall_frac`` (input-pipeline wait fraction). JSONL records carry a
+``schema_version`` key plus the same triple (None when uncomputable — the
+KEYS are the contract), and optionally per-phase span summaries
+(``phases``) from the telemetry tracer. Changing either key set without
+bumping ``SCHEMA_VERSION`` fails the drift-guard test
+(tests/test_telemetry.py).
+
+Multi-process discipline: every host used to append to the SAME
+``cfg.metrics_file``, interleaving lines from all processes into one
+unparseable file; MetricsLogger now suffixes the path with the process
+index (``m.jsonl.p1``...) whenever more than one process is running —
+process 0 keeps the bare path, so single-host tooling is unchanged. It is
+also a context manager, so trainers close the handle on exceptions, not
+just at clean ``train()`` exit.
 """
 
 import json
 import re
 import time
 from typing import IO, Optional
+
+SCHEMA_VERSION = 2
+
+# v1 keys (order is part of the human-line contract) + the v2 suffix.
+V1_LINE_KEYS = ("step", "epoch", "loss", "acc", "participating",
+                "step_time", "data_time")
+V2_LINE_KEYS = V1_LINE_KEYS + ("mfu", "examples_per_sec", "data_stall_frac")
+# JSONL record keys every v2 record carries (extras are additive).
+JSONL_BASE_KEYS = ("schema_version", "ts") + V2_LINE_KEYS
 
 # Stable human schema. Field order is part of the contract.
 _LINE = ("STEP {step} epoch {epoch} loss {loss:.6f} acc {acc:.4f} "
@@ -22,13 +50,30 @@ _LINE_RE = re.compile(
     r"STEP (?P<step>\d+) epoch (?P<epoch>\d+) loss (?P<loss>[-\d.naninf]+) "
     r"acc (?P<acc>[-\d.naninf]+) participating (?P<participating>[-\d.]+) "
     r"step_time (?P<step_time>[\d.]+) data_time (?P<data_time>[\d.]+)")
+# v2 suffix: optional as a whole (v1 lines parse), 'n/a' for an unknown MFU
+# (CPU has no published peak) so the line never prints a fictional 0.
+_V2_RE = re.compile(
+    r" mfu (?P<mfu>[-\d.einaf]+|n/a) ips (?P<examples_per_sec>[-\d.einaf]+)"
+    r" stall (?P<data_stall_frac>[-\d.einaf]+)")
 
 
 def format_line(step: int, epoch: int, loss: float, acc: float,
-                participating: float, step_time: float, data_time: float) -> str:
-    return _LINE.format(step=step, epoch=epoch, loss=loss, acc=acc,
+                participating: float, step_time: float, data_time: float,
+                mfu: Optional[float] = None,
+                examples_per_sec: Optional[float] = None,
+                data_stall_frac: Optional[float] = None) -> str:
+    """v1 seven-field line; the v2 utilization suffix is appended whenever
+    any v2 field is provided (so pre-v2 call sites emit byte-identical v1
+    lines)."""
+    line = _LINE.format(step=step, epoch=epoch, loss=loss, acc=acc,
                         participating=participating, step_time=step_time,
                         data_time=data_time)
+    if mfu is not None or examples_per_sec is not None \
+            or data_stall_frac is not None:
+        line += (f" mfu {'n/a' if mfu is None else format(mfu, '.4f')}"
+                 f" ips {0.0 if examples_per_sec is None else examples_per_sec:.1f}"
+                 f" stall {0.0 if data_stall_frac is None else data_stall_frac:.3f}")
+    return line
 
 
 def parse_line(line: str) -> Optional[dict]:
@@ -36,31 +81,62 @@ def parse_line(line: str) -> Optional[dict]:
     if not m:
         return None
     d = m.groupdict()
-    return {"step": int(d["step"]), "epoch": int(d["epoch"]),
-            "loss": float(d["loss"]), "acc": float(d["acc"]),
-            "participating": float(d["participating"]),
-            "step_time": float(d["step_time"]), "data_time": float(d["data_time"])}
+    rec = {"step": int(d["step"]), "epoch": int(d["epoch"]),
+           "loss": float(d["loss"]), "acc": float(d["acc"]),
+           "participating": float(d["participating"]),
+           "step_time": float(d["step_time"]),
+           "data_time": float(d["data_time"])}
+    m2 = _V2_RE.search(line, m.end())
+    if m2:
+        rec["mfu"] = None if m2["mfu"] == "n/a" else float(m2["mfu"])
+        rec["examples_per_sec"] = float(m2["examples_per_sec"])
+        rec["data_stall_frac"] = float(m2["data_stall_frac"])
+    return rec
 
 
 class MetricsLogger:
-    """Per-step sink: stdout human line + optional JSONL file."""
+    """Per-step sink: stdout human line + optional JSONL file.
+
+    ``process_index``/``num_processes``: with >1 process the JSONL path is
+    suffixed ``.p<index>`` so hosts never interleave writes into one file
+    (process 0 keeps the bare path — single-host tooling reads it as
+    before; tools/analyze.py accepts the ``.p*`` set as one run).
+    """
 
     def __init__(self, jsonl_path: str = "", log_every: int = 1,
-                 printer=print):
+                 printer=print, process_index: int = 0,
+                 num_processes: int = 1):
         self.log_every = max(log_every, 1)
         self.printer = printer
+        if jsonl_path and num_processes > 1 and process_index > 0:
+            jsonl_path = f"{jsonl_path}.p{process_index}"
+        self.jsonl_path = jsonl_path
         self._fh: Optional[IO] = open(jsonl_path, "a") if jsonl_path else None
+
+    def __enter__(self) -> "MetricsLogger":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.close()
 
     def log_step(self, step: int, epoch: int, *, loss: float, acc: float,
                  participating: float, step_time: float, data_time: float,
+                 mfu: Optional[float] = None,
+                 examples_per_sec: Optional[float] = None,
+                 data_stall_frac: Optional[float] = None,
                  **extra) -> None:
         if step % self.log_every == 0:
             self.printer(format_line(step, epoch, loss, acc, participating,
-                                     step_time, data_time))
+                                     step_time, data_time, mfu=mfu,
+                                     examples_per_sec=examples_per_sec,
+                                     data_stall_frac=data_stall_frac))
         if self._fh is not None:
-            rec = {"ts": time.time(), "step": step, "epoch": epoch,
+            rec = {"schema_version": SCHEMA_VERSION, "ts": time.time(),
+                   "step": step, "epoch": epoch,
                    "loss": loss, "acc": acc, "participating": participating,
-                   "step_time": step_time, "data_time": data_time, **extra}
+                   "step_time": step_time, "data_time": data_time,
+                   "mfu": mfu, "examples_per_sec": examples_per_sec,
+                   "data_stall_frac": data_stall_frac, **extra}
             self._fh.write(json.dumps(rec) + "\n")
             self._fh.flush()
 

@@ -148,7 +148,9 @@ class MultiSliceTrainer:
                                       cfg.dataset, train=False, shuffle=False,
                                       seed=cfg.seed, drop_last=False,
                                       device_normalize=dev_norm)
-        self.metrics = MetricsLogger(cfg.metrics_file, cfg.log_every)
+        self.metrics = MetricsLogger(cfg.metrics_file, cfg.log_every,
+                                     process_index=jax.process_index(),
+                                     num_processes=jax.process_count())
         self.step = 0          # canonical (master) step
         self.applied = 0       # updates actually applied
         self.dropped_stale = 0

@@ -34,6 +34,10 @@ from ps_pytorch_tpu.parallel.mesh import local_data_shard
 from ps_pytorch_tpu.runtime import checkpoint as ckpt
 from ps_pytorch_tpu.runtime.coordinator import Coordinator
 from ps_pytorch_tpu.runtime.metrics import MetricsLogger
+from ps_pytorch_tpu.telemetry import (
+    TelemetryAggregator, Tracer, aggregate_peak_flops, derive_step_record,
+    set_default_tracer, step_flops_of,
+)
 
 from ps_pytorch_tpu.data.datasets import sample_shape
 
@@ -91,7 +95,36 @@ class Trainer:
         self._local_replicas = [
             i for i, row in enumerate(self.mesh.devices)
             if row.flat[0].process_index == jax.process_index()]
-        self.metrics = MetricsLogger(cfg.metrics_file, cfg.log_every)
+        self.metrics = MetricsLogger(cfg.metrics_file, cfg.log_every,
+                                     process_index=jax.process_index(),
+                                     num_processes=jax.process_count())
+        # Host-side span tracer; installed as the ambient default so the
+        # library layers' span() calls (checkpoint writes, coordinator
+        # rounds, KV transport) land on this host's timeline too.
+        self.tracer = Tracer(pid=jax.process_index())
+        # The previous default is restored when train() exits so a trainer
+        # never leaks its tracer into unrelated code running afterwards.
+        self._prev_tracer = set_default_tracer(self.tracer)
+        # MFU inputs: per-step FLOPs are traced lazily at step 1 (the step
+        # must exist first); the chips' peak is a device_kind lookup (None
+        # off-TPU -> mfu reported as null, never a fiction).
+        self._flops_per_step: Optional[int] = None
+        self._n_chips = int(self.mesh.devices.size)
+        self._peak_per_chip = aggregate_peak_flops(
+            list(self.mesh.devices.flat))
+        # Cross-host step telemetry over the control-plane KV: every process
+        # publishes per-step durations + phase summaries; the leader drains
+        # them into ONE merged per-replica timeline JSONL.
+        timeline = cfg.timeline_file or (
+            f"{cfg.metrics_file}.timeline"
+            if dist.is_multiprocess() and cfg.metrics_file else "")
+        self._telemetry: Optional[TelemetryAggregator] = None
+        if timeline:
+            self._telemetry = TelemetryAggregator(
+                self.coordinator.kv, jax.process_index(),
+                jax.process_count(), run_id=self.coordinator.run_id)
+            if jax.process_index() == 0:
+                self._telemetry.open_timeline(timeline)
         # jax.profiler trace window (SURVEY §5.1: the reference's hand-rolled
         # timers + our structured lines, plus real profiler integration).
         self._profile_range = None
@@ -144,71 +177,115 @@ class Trainer:
         last_step = min(cfg.max_steps, epoch_budget)
         step = self.start_step
         m_prev = None
-        while step < last_step:
-            step += 1
-            if self._profile_range:
-                lo, hi = self._profile_range
-                # Window-membership, not step equality: a resumed run may
-                # enter the loop past `lo` (or never reach `hi`).
-                if not self._trace_active and lo <= step <= hi:
-                    jax.profiler.start_trace(self.cfg.profile_dir)
-                    self._trace_active = True
-                elif self._trace_active and step > hi:
-                    jax.profiler.stop_trace()
-                    self._trace_active = False
-                    self._profile_range = None
-            self.coordinator.announce_step(step)
-            t0 = time.monotonic()
-            x, y = self.train_loader.next_batch()
-            t_data = time.monotonic() - t0
-            mask = self.coordinator.participation_mask(step)
-            # Legacy uint32[2] key: globalizable as a plain replicated array
-            # (typed key dtypes can't cross make_array_from_callback).
-            key = np.asarray(jax.random.PRNGKey(cfg.seed * 100003 + step))
-            new_state, m = self.step_fn(
-                self.state,
-                dist.globalize_batch(self.mesh, np.asarray(x)),
-                dist.globalize_batch(self.mesh, np.asarray(y)),
-                dist.globalize_replicated(self.mesh, np.asarray(mask, np.float32)),
-                dist.globalize_replicated(self.mesh, key, spec=jax.sharding.PartitionSpec()))
-            self.state = new_state
-            if cfg.inject_step_delay > 0 and \
-                    jax.process_index() == cfg.inject_delay_process:
-                # Fault injection (tests/ops drills): make THIS host a
-                # straggler. The reference had no fault injection at all
-                # (SURVEY §5.3); its stragglers were organic EC2 noise.
-                time.sleep(cfg.inject_step_delay)
-            # 1-deep pipeline: completing step-1 before dispatching step+1
-            # keeps device/host overlap while making the per-iteration wall
-            # time a TRUE per-step duration — reported EVERY step, so the
-            # kofn/deadline policies never act on stale numbers (the round-1
-            # telemetry was gated on log_every; the reference timed every
-            # worker step, distributed_worker.py:169-173).
-            if m_prev is not None:
-                _ = float(m_prev["loss"])
-            m_prev = m
-            t_step = time.monotonic() - t0
-            for r in self._local_replicas:
-                self.coordinator.report_duration(r, step, t_step)
-            if step % cfg.log_every == 0 or step == last_step:
-                # Materializing metrics fully syncs the device.
-                loss = float(m["loss"])
-                acc = float(m["accuracy"])
-                part = float(m["participating"])
+        try:
+            while step < last_step:
+                step += 1
+                if self._profile_range:
+                    lo, hi = self._profile_range
+                    # Window-membership, not step equality: a resumed run may
+                    # enter the loop past `lo` (or never reach `hi`).
+                    if not self._trace_active and lo <= step <= hi:
+                        jax.profiler.start_trace(self.cfg.profile_dir)
+                        self._trace_active = True
+                    elif self._trace_active and step > hi:
+                        jax.profiler.stop_trace()
+                        self._trace_active = False
+                        self._profile_range = None
+                self.coordinator.announce_step(step)
+                t0 = time.monotonic()
+                with self.tracer.span("data_wait", step=step):
+                    x, y = self.train_loader.next_batch()
+                t_data = time.monotonic() - t0
+                mask = self.coordinator.participation_mask(step)
+                # Legacy uint32[2] key: globalizable as a plain replicated array
+                # (typed key dtypes can't cross make_array_from_callback).
+                key = np.asarray(jax.random.PRNGKey(cfg.seed * 100003 + step))
+                xg = dist.globalize_batch(self.mesh, np.asarray(x))
+                yg = dist.globalize_batch(self.mesh, np.asarray(y))
+                mg = dist.globalize_replicated(self.mesh,
+                                               np.asarray(mask, np.float32))
+                kg = dist.globalize_replicated(
+                    self.mesh, key, spec=jax.sharding.PartitionSpec())
+                if self._flops_per_step is None:
+                    # One abstract trace of the full fwd+bwd+update program
+                    # (nothing executes); -1 = "tried, uncountable" so a
+                    # failure is not retried every step.
+                    self._flops_per_step = step_flops_of(
+                        self.step_fn, self.state, xg, yg, mg, kg) or -1
+                with self.tracer.span("host_dispatch", step=step):
+                    new_state, m = self.step_fn(self.state, xg, yg, mg, kg)
+                self.state = new_state
+                if cfg.inject_step_delay > 0 and \
+                        jax.process_index() == cfg.inject_delay_process:
+                    # Fault injection (tests/ops drills): make THIS host a
+                    # straggler. The reference had no fault injection at all
+                    # (SURVEY §5.3); its stragglers were organic EC2 noise.
+                    time.sleep(cfg.inject_step_delay)
+                # 1-deep pipeline: completing step-1 before dispatching step+1
+                # keeps device/host overlap while making the per-iteration wall
+                # time a TRUE per-step duration — reported EVERY step, so the
+                # kofn/deadline policies never act on stale numbers (the round-1
+                # telemetry was gated on log_every; the reference timed every
+                # worker step, distributed_worker.py:169-173).
+                with self.tracer.span("device_sync", step=step):
+                    if m_prev is not None:
+                        _ = float(m_prev["loss"])
+                m_prev = m
                 t_step = time.monotonic() - t0
-                epoch = (step - 1) // steps_per_epoch
-                self.metrics.log_step(step, epoch, loss=loss, acc=acc,
-                                      participating=part, step_time=t_step,
-                                      data_time=t_data)
-            if cfg.eval_freq > 0 and step % cfg.eval_freq == 0:
-                self._checkpoint(step)
-        jax.block_until_ready(self.state.params)
-        if self._trace_active:
-            jax.profiler.stop_trace()  # run ended inside the trace window
-            self._trace_active = False
-        if cfg.eval_freq > 0 and step % cfg.eval_freq != 0:
-            self._checkpoint(step)
-        self.metrics.close()
+                for r in self._local_replicas:
+                    self.coordinator.report_duration(r, step, t_step)
+                if self._telemetry is not None:
+                    self._telemetry.publish_step(step, {
+                        "step_time": round(t_step, 6),
+                        "data_time": round(t_data, 6),
+                        "phases": self.tracer.step_summary(step)})
+                    self._telemetry.drain_to_file()  # no-op off-leader
+                if step % cfg.log_every == 0 or step == last_step:
+                    # Materializing metrics fully syncs the device — in its
+                    # own span, and the REPORTED step_time stays the pre-sync
+                    # duration computed above (the one the coordinator's
+                    # policies see), so logged and policy-visible durations
+                    # agree instead of silently folding this sync in.
+                    with self.tracer.span("metrics_sync", step=step):
+                        loss = float(m["loss"])
+                        acc = float(m["accuracy"])
+                        part = float(m["participating"])
+                    epoch = (step - 1) // steps_per_epoch
+                    derived = derive_step_record(
+                        step_time_s=t_step, data_time_s=t_data,
+                        examples=cfg.batch_size,
+                        flops_per_step=(self._flops_per_step
+                                        if self._flops_per_step and
+                                        self._flops_per_step > 0 else None),
+                        peak_flops_per_chip=self._peak_per_chip,
+                        n_chips=self._n_chips)
+                    self.metrics.log_step(
+                        step, epoch, loss=loss, acc=acc, participating=part,
+                        step_time=t_step, data_time=t_data,
+                        phases=self.tracer.step_summary(step), **derived)
+                if cfg.eval_freq > 0 and step % cfg.eval_freq == 0:
+                    with self.tracer.span("checkpoint", step=step):
+                        self._checkpoint(step)
+            jax.block_until_ready(self.state.params)
+            if cfg.eval_freq > 0 and step % cfg.eval_freq != 0:
+                with self.tracer.span("checkpoint", step=step):
+                    self._checkpoint(step)
+        finally:
+            # Telemetry sinks close on ANY exit — a trainer exception must
+            # not leak the JSONL handle or lose the trace collected so far.
+            if self._trace_active:
+                jax.profiler.stop_trace()
+                self._trace_active = False
+            self.metrics.close()
+            if cfg.trace_file:
+                path = cfg.trace_file
+                if jax.process_index() > 0:
+                    path = f"{path}.p{jax.process_index()}"
+                self.tracer.write_chrome_trace(path)
+            if self._telemetry is not None:
+                self._telemetry.close(
+                    final_step=step if jax.process_index() == 0 else None)
+            set_default_tracer(self._prev_tracer)
         return self.state
 
     def evaluate(self, max_batches: Optional[int] = None) -> dict:

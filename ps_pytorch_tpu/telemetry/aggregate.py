@@ -1,0 +1,132 @@
+"""Cross-host telemetry aggregation over the control-plane KV.
+
+The coordinator's kofn/deadline policies act on per-replica durations, but
+until now that evidence was invisible: each host saw only its own timings,
+and the leader's mask decisions could not be audited after the fact. Here
+every process publishes its per-step record (true step time, data wait,
+span phase summary) through the SAME KV the control plane rides
+(runtime/coordinator.py KVStore / DistributedKV), and the leader drains
+them into ONE merged JSONL — a per-replica timeline artifact. A straggler
+event is then a visible row ("process 3, step 412, step_time 2.1s,
+data_wait 1.9s"), not an inferred mask flip.
+
+Wire discipline mirrors transport.py: per-process keys under
+``<run>/tel/<pid>/<step>`` land before the ``<run>/tel/<pid>/last`` pointer
+moves, and publishers GC their own keys beyond ``window`` steps — the
+leader must drain within the window (it drains every step, so the window
+only has to absorb scheduling jitter, same argument as the coordinator's
+mask_gc_window).
+"""
+
+import json
+import os
+import time
+from typing import IO, List, Optional
+
+SCHEMA_VERSION = 2
+
+
+class TelemetryAggregator:
+    """Per-process publisher + leader-side merger of step telemetry."""
+
+    def __init__(self, kv, process_index: int, num_processes: int,
+                 run_id: str = "run", window: int = 512):
+        self.kv = kv
+        self.pid = int(process_index)
+        self.n = int(num_processes)
+        self.run_id = run_id
+        self.window = max(int(window), 2)
+        # Leader-side drain cursors: last step already merged, per process.
+        self._cursor = [0] * self.n
+        self._fh: Optional[IO] = None
+        self.rows_written = 0
+
+    def _key(self, pid: int, step) -> str:
+        return f"{self.run_id}/tel/{pid}/{step}"
+
+    # ---- every process: publish ----
+    def publish_step(self, step: int, record: dict) -> None:
+        """Publish this process's record for ``step``; payload before
+        pointer, then GC our own key beyond the window."""
+        self.kv.set(self._key(self.pid, step), json.dumps(record))
+        self.kv.set(self._key(self.pid, "last"), str(step))
+        if step > self.window:
+            self.kv.delete(self._key(self.pid, step - self.window))
+
+    def last_published(self, pid: int) -> int:
+        v = self.kv.get(self._key(pid, "last"))
+        return int(v) if v is not None else 0
+
+    def fetch(self, pid: int, step: int) -> Optional[dict]:
+        v = self.kv.get(self._key(pid, step))
+        return json.loads(v) if v is not None else None
+
+    # ---- leader: merge ----
+    def drain(self) -> List[dict]:
+        """Newly-published rows from every process, in (step, process)
+        order. A GC'd/lost step advances the cursor (a hole in the
+        timeline, visible as a gap, must not wedge the merge)."""
+        rows = []
+        for pid in range(self.n):
+            last = self.last_published(pid)
+            for step in range(self._cursor[pid] + 1, last + 1):
+                rec = self.fetch(pid, step)
+                if rec is not None:
+                    rows.append({"schema_version": SCHEMA_VERSION,
+                                 "step": step, "process": pid, **rec})
+            self._cursor[pid] = max(self._cursor[pid], last)
+        rows.sort(key=lambda r: (r["step"], r["process"]))
+        return rows
+
+    def open_timeline(self, path: str) -> None:
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        self._fh = open(path, "w")
+
+    def drain_to_file(self) -> int:
+        if self._fh is None:
+            return 0
+        rows = self.drain()
+        for r in rows:
+            self._fh.write(json.dumps(r) + "\n")
+        if rows:
+            self._fh.flush()
+            self.rows_written += len(rows)
+        return len(rows)
+
+    def close(self, final_step: Optional[int] = None,
+              timeout_s: float = 10.0, poll_s: float = 0.05) -> None:
+        """Final drain. With ``final_step``, wait (bounded) for every
+        process to publish through it — followers lag the leader by the
+        async-dispatch depth, and the artifact should not end mid-step."""
+        if self._fh is None:
+            return
+        deadline = time.monotonic() + timeout_s
+        while True:
+            self.drain_to_file()
+            if final_step is None or \
+                    all(c >= final_step for c in self._cursor):
+                break
+            if time.monotonic() > deadline:
+                break
+            time.sleep(poll_s)
+        self._fh.close()
+        self._fh = None
+
+
+def read_timeline(path: str) -> List[dict]:
+    """Merged-timeline JSONL -> rows (tools/analyze.py timeline mode)."""
+    rows = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                r = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(r, dict) and "step" in r:
+                rows.append(r)
+    return rows

@@ -1,0 +1,200 @@
+"""Typed counters/gauges registry + the derived per-step record.
+
+The weight-update-sharding paper's point (PAPERS.md) is that raw step time
+is not the metric — utilization is. This module owns the arithmetic the
+MetricsLogger v2 record carries beyond the reference's loss/time pair:
+
+- MFU: analytic step FLOPs (utils/flops.py jaxpr traversal) divided by
+  wall time and by the chips' aggregate peak (``peak_flops_bf16``). On a
+  backend without a published peak (CPU) MFU is None, never a fiction.
+- goodput: examples/sec (or tokens/sec for the LM surface) actually
+  trained, i.e. global batch over the TRUE per-step wall time.
+- data_stall_frac: the fraction of the step the host spent waiting on the
+  input pipeline — the one number that says whether the loader or the chip
+  is the bottleneck (PERF.md §5's ratio, now per step, per run).
+- device memory: ``memory_stats()`` peak/current bytes when the backend
+  reports them (memory_probe-style, inline instead of a separate drill).
+
+The Registry itself is deliberately small: metrics must be DECLARED (name,
+kind, unit, help) before use, so the set of emitted fields is a reviewable
+contract rather than whatever strings the call sites happened to pass —
+the same schema-discipline argument as runtime/metrics.py, applied to
+counters.
+"""
+
+import threading
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+
+@dataclass(frozen=True)
+class MetricSpec:
+    name: str
+    kind: str          # "counter" (monotonic) | "gauge" (set to any value)
+    unit: str = ""
+    help: str = ""
+
+    def __post_init__(self):
+        if self.kind not in ("counter", "gauge"):
+            raise ValueError(f"metric kind {self.kind!r} (counter | gauge)")
+
+
+class Registry:
+    """Declared-metrics store. ``inc`` only on counters, ``set`` only on
+    gauges; touching an undeclared name raises — typos surface at the call
+    site, not as silently-new JSONL keys."""
+
+    def __init__(self):
+        self._specs: Dict[str, MetricSpec] = {}
+        self._values: Dict[str, float] = {}
+        self._lock = threading.Lock()
+
+    def counter(self, name: str, unit: str = "", help: str = "") -> str:
+        return self._declare(MetricSpec(name, "counter", unit, help))
+
+    def gauge(self, name: str, unit: str = "", help: str = "") -> str:
+        return self._declare(MetricSpec(name, "gauge", unit, help))
+
+    def _declare(self, spec: MetricSpec) -> str:
+        with self._lock:
+            old = self._specs.get(spec.name)
+            if old is not None and old != spec:
+                raise ValueError(f"metric {spec.name!r} re-declared as "
+                                 f"{spec.kind}, was {old.kind}")
+            self._specs[spec.name] = spec
+            self._values.setdefault(spec.name, 0.0)
+        return spec.name
+
+    def _spec(self, name: str, kind: str) -> MetricSpec:
+        spec = self._specs.get(name)
+        if spec is None:
+            raise KeyError(f"metric {name!r} not declared")
+        if spec.kind != kind:
+            raise TypeError(f"metric {name!r} is a {spec.kind}, not a {kind}")
+        return spec
+
+    def inc(self, name: str, value: float = 1.0) -> float:
+        self._spec(name, "counter")
+        if value < 0:
+            raise ValueError(f"counter {name!r} cannot decrease")
+        with self._lock:
+            self._values[name] += value
+            return self._values[name]
+
+    def set(self, name: str, value: float) -> float:
+        self._spec(name, "gauge")
+        with self._lock:
+            self._values[name] = float(value)
+            return self._values[name]
+
+    def get(self, name: str) -> float:
+        with self._lock:
+            if name not in self._specs:
+                raise KeyError(f"metric {name!r} not declared")
+            return self._values[name]
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._values)
+
+    def specs(self) -> Dict[str, MetricSpec]:
+        with self._lock:
+            return dict(self._specs)
+
+
+# ---- derived per-step arithmetic (one definition; PERF.md cites this) ----
+
+def compute_mfu(flops_per_step: Optional[int], step_time_s: float,
+                peak_flops_per_chip: Optional[float],
+                n_chips: int = 1) -> Optional[float]:
+    """Model FLOPs utilization: achieved FLOPs/sec over aggregate peak.
+
+    None (not 0.0) whenever an input is unknown — an unknown peak (CPU) or
+    an uncounted step must read as "no claim", never as "0% utilized".
+    """
+    if not flops_per_step or flops_per_step <= 0 or step_time_s <= 0:
+        return None
+    if not peak_flops_per_chip or n_chips <= 0:
+        return None
+    return flops_per_step / (step_time_s * peak_flops_per_chip * n_chips)
+
+
+def data_stall_fraction(data_time_s: float,
+                        step_time_s: float) -> Optional[float]:
+    """Fraction of the step spent waiting on the input pipeline, clamped to
+    [0, 1] (a prefetched loader can report ~0 even when the host is busy)."""
+    if step_time_s <= 0:
+        return None
+    return max(0.0, min(1.0, data_time_s / step_time_s))
+
+
+def device_memory_record(device=None) -> dict:
+    """{"device_mem_peak_bytes", "device_mem_bytes"} via the backend's
+    memory_stats(); {} when the backend has none (CPU) — additive fields,
+    absent rather than null, so CPU JSONL stays compact."""
+    try:
+        if device is None:
+            import jax
+            device = jax.local_devices()[0]
+        stats = device.memory_stats() or {}
+    except Exception:
+        return {}
+    out = {}
+    if stats.get("peak_bytes_in_use") is not None:
+        out["device_mem_peak_bytes"] = int(stats["peak_bytes_in_use"])
+    if stats.get("bytes_in_use") is not None:
+        out["device_mem_bytes"] = int(stats["bytes_in_use"])
+    return out
+
+
+def derive_step_record(*, step_time_s: float, data_time_s: float = 0.0,
+                       examples: Optional[int] = None,
+                       tokens: Optional[int] = None,
+                       flops_per_step: Optional[int] = None,
+                       peak_flops_per_chip: Optional[float] = None,
+                       n_chips: int = 1, device=None,
+                       with_memory: bool = True) -> dict:
+    """The MetricsLogger v2 derived fields for one step.
+
+    Always contains ``mfu``, ``examples_per_sec``, ``data_stall_frac``
+    (None when uncomputable — the keys are the schema); ``tokens_per_sec``
+    and device-memory fields are additive when available.
+    """
+    rec = {
+        "mfu": (None if (m := compute_mfu(flops_per_step, step_time_s,
+                                          peak_flops_per_chip, n_chips))
+                is None else round(m, 6)),
+        "examples_per_sec": (round(examples / step_time_s, 2)
+                            if examples and step_time_s > 0 else None),
+        "data_stall_frac": (None if (f := data_stall_fraction(
+            data_time_s, step_time_s)) is None else round(f, 4)),
+    }
+    if tokens and step_time_s > 0:
+        rec["tokens_per_sec"] = round(tokens / step_time_s, 1)
+    if with_memory:
+        rec.update(device_memory_record(device))
+    return rec
+
+
+def step_flops_of(fn, *args) -> Optional[int]:
+    """Analytic FLOPs of one call of ``fn(*args)`` (utils/flops.py jaxpr
+    traversal — recurses through the pjit wrapper of a jitted step), or
+    None when the trace fails. Trace once, divide every step."""
+    try:
+        from ps_pytorch_tpu.utils.flops import forward_flops
+        return forward_flops(fn, *args)
+    except Exception:
+        return None
+
+
+def aggregate_peak_flops(devices=None) -> Optional[float]:
+    """Per-chip peak for the devices' kind (utils/flops.peak_flops_bf16);
+    None off-TPU."""
+    try:
+        if devices is None:
+            import jax
+            devices = jax.devices()
+        from ps_pytorch_tpu.utils.flops import peak_flops_bf16
+        return peak_flops_bf16(devices[0].device_kind)
+    except Exception:
+        return None

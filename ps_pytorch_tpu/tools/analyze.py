@@ -15,6 +15,15 @@ notebooks' max/min-per-step computation. Speedups are reported against the
 run labeled as baseline (default: the smallest device count).
 
     python -m ps_pytorch_tpu.tools.analyze 1=logs/n1.jsonl 8=logs/n8_host*.log
+
+Timeline mode reads the telemetry the trainers now emit — per-step phase
+span summaries (``phases`` in metrics JSONL) or the leader-merged
+per-replica timeline (telemetry/aggregate.py) — and prints where the step
+time actually goes, per phase; ``--json`` additionally emits the
+(step, process, step_time) grid that a straggler heatmap plots directly:
+
+    python -m ps_pytorch_tpu.tools.analyze timeline /tmp/m.jsonl
+    python -m ps_pytorch_tpu.tools.analyze timeline run.jsonl.timeline --json
 """
 
 import argparse
@@ -103,14 +112,94 @@ def to_markdown(rows: List[dict]) -> str:
     return "\n".join([head, sep] + body)
 
 
+# ---- timeline mode (per-phase breakdown + straggler heatmap input) ----
+
+def phase_breakdown(rows: List[dict], skip_first: int = 1) -> List[dict]:
+    """Step records carrying ``phases`` -> one row per phase:
+    mean/max/total seconds and the share of the mean step time. Phases are
+    the trainers' span names (data_wait, host_dispatch, device_sync,
+    metrics_sync, checkpoint, coordinator_mask, wire_*...); 'other' is the
+    un-spanned remainder of the step."""
+    steps = sorted({r["step"] for r in rows})[skip_first:]
+    keep = [r for r in rows if r["step"] in set(steps)]
+    if not keep:
+        raise ValueError("no step records with phase data")
+    per_phase: Dict[str, List[float]] = {}
+    step_times = []
+    for r in keep:
+        st = float(r.get("step_time") or 0.0)
+        step_times.append(st)
+        spanned = 0.0
+        for name, dur in (r.get("phases") or {}).items():
+            per_phase.setdefault(name, []).append(float(dur))
+            spanned += float(dur)
+        if st > spanned >= 0:
+            per_phase.setdefault("other", []).append(st - spanned)
+    mean_step = statistics.fmean(step_times) if step_times else 0.0
+    out = []
+    for name in sorted(per_phase, key=lambda n: -sum(per_phase[n])):
+        vals = per_phase[name]
+        mean = statistics.fmean(vals)
+        out.append({
+            "phase": name, "count": len(vals),
+            "mean_s": round(mean, 6), "max_s": round(max(vals), 6),
+            "total_s": round(sum(vals), 6),
+            "frac_of_step": round(mean / mean_step, 4) if mean_step > 0 else 0.0,
+        })
+    return out
+
+
+def straggler_grid(rows: List[dict]) -> List[dict]:
+    """(step, process, step_time) triples — the heatmap input. Metrics
+    JSONL has no process column (one file per host); the merged timeline
+    does."""
+    return [{"step": r["step"], "process": int(r.get("process", 0)),
+             "step_time": float(r.get("step_time") or 0.0)}
+            for r in sorted(rows, key=lambda r: (r["step"],
+                                                 r.get("process", 0)))]
+
+
+def timeline_markdown(breakdown: List[dict]) -> str:
+    head = "| phase | count | mean | max | total | % of step |"
+    sep = "|---|---|---|---|---|---|"
+    body = [
+        f"| {r['phase']} | {r['count']} | {r['mean_s']:.6f} s "
+        f"| {r['max_s']:.6f} s | {r['total_s']:.6f} s "
+        f"| {100 * r['frac_of_step']:.1f}% |"
+        for r in breakdown]
+    return "\n".join([head, sep] + body)
+
+
+def timeline_main(args, parser) -> int:
+    files: List[str] = []
+    for pattern in args.runs:
+        files.extend(sorted(glob.glob(pattern)) or
+                     parser.error(f"no files match {pattern!r}") or [])
+    rows = [r for path in files for r in read_records(path)]
+    if not rows:
+        parser.error(f"no step records in {files}")
+    breakdown = phase_breakdown(rows, skip_first=args.skip_first)
+    if args.json:
+        print(json.dumps({"phases": breakdown,
+                          "heatmap": straggler_grid(rows)}))
+    else:
+        print(timeline_markdown(breakdown))
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("runs", nargs="+",
-                   help="LABEL=GLOB pairs, e.g. 1=n1.jsonl 8='n8_host*.log'")
+                   help="LABEL=GLOB pairs, e.g. 1=n1.jsonl 8='n8_host*.log'; "
+                        "or: timeline FILE... for a per-phase breakdown")
     p.add_argument("--baseline", default="", help="label to normalize against")
     p.add_argument("--skip-first", type=int, default=1)
     p.add_argument("--json", action="store_true", help="emit JSON rows instead")
     args = p.parse_args(argv)
+
+    if args.runs[0] == "timeline":
+        args.runs = args.runs[1:] or p.error("timeline mode needs FILE...")
+        return timeline_main(args, p)
 
     runs: Dict[str, List[str]] = {}
     for spec in args.runs:

@@ -203,6 +203,30 @@ def collect(repo: str):
             "value": ",".join(str(s) for s in d.get("sizes", [])),
             "unit": "workers", "platform": d.get("platform"),
             "ok": bool(d.get("modes"))})
+    p = _newest("TELEMETRY_r[0-9]*.json", repo)
+    if p:
+        # Telemetry evidence: either the analyze-timeline --json object
+        # ({"phases": [...], "heatmap": [...]}) or a raw metrics/timeline
+        # JSONL of v2 step records.
+        from ps_pytorch_tpu.runtime.metrics import SCHEMA_VERSION
+        d = _load(p)
+        if isinstance(d, list):
+            steps = [r for r in d if "step" in r]
+            vers = {r.get("schema_version") for r in steps}
+            add("telemetry", p, {
+                "value": len(steps), "unit": "step records",
+                "platform": "host",
+                "ok": bool(steps) and vers <= {SCHEMA_VERSION}})
+        else:
+            d = as_dict(d)
+            phases = d.get("phases") or []
+            top = phases[0] if phases else {}
+            add("telemetry", p, {
+                "value": top.get("phase"),
+                "unit": "top phase ({:.0f}% of step)".format(
+                    100 * (top.get("frac_of_step") or 0)),
+                "platform": d.get("platform", "host"),
+                "ok": bool(phases) and "_parse_error" not in d})
     p = os.path.join(repo, "COPYCHECK.json")
     if os.path.exists(p):
         d = as_dict(_load(p))
