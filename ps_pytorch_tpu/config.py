@@ -102,6 +102,18 @@ class TrainConfig:
     inject_step_delay: float = 0.0   # seconds of artificial per-step delay
     inject_delay_process: int = -1   # process_index to slow; -1 = nobody
 
+    # -- resilience (resilience/: deterministic chaos, liveness, retries,
+    #    hardened checkpoints; generalizes the reference's tag-77/backup-
+    #    worker straggler handling to crashes and flaky control planes) --
+    fault_spec: str = ""             # seeded fault plane, e.g. "kv_drop:p=0.05,seed=7;replica_crash:r=0,step=40;ckpt_corrupt:step=20" (resilience/faults.py grammar)
+    heartbeat_interval_s: float = 0.0  # per-process liveness beat period in seconds; 0 = heartbeats off
+    heartbeat_timeout_s: float = 0.0   # missed-beat deadline before mask eviction; 0 = 3x interval
+    kv_retry_attempts: int = 5       # attempts per KV op on transient coordination-service errors; 1 = no retries
+    kv_retry_base_s: float = 0.05    # backoff base (exponential x2, jittered, capped at 2 s)
+    kv_retry_budget: int = 1000      # run-wide retry budget before failing fast; 0 = unbounded
+    ckpt_keep: int = 0               # keep-last-N committed checkpoints; 0 = keep all
+    auto_resume: int = 0             # max automatic restarts from the latest VALID checkpoint after a crash (train.py)
+
     # -- logging / profiling / telemetry --
     log_every: int = 1
     metrics_file: str = ""          # optional JSONL metrics sink ("" = stdout only; multi-process runs suffix .p<k> per host)
@@ -146,6 +158,22 @@ class TrainConfig:
                              "(must be >= 0; 0 = one per CPU)")
         if self.nesterov and (self.momentum <= 0):
             raise ValueError("Nesterov momentum requires a momentum")
+        if self.fault_spec:
+            # Parse now: a typo'd spec must fail at config time, not
+            # mid-run when the fault would have fired.
+            from ps_pytorch_tpu.resilience.faults import parse_fault_spec
+            parse_fault_spec(self.fault_spec)
+        if self.kv_retry_attempts < 1:
+            raise ValueError(f"kv_retry_attempts={self.kv_retry_attempts} "
+                             "(must be >= 1; 1 = no retries)")
+        for name in ("heartbeat_interval_s", "heartbeat_timeout_s",
+                     "kv_retry_base_s"):
+            if getattr(self, name) < 0:
+                raise ValueError(f"{name} must be >= 0")
+        if self.ckpt_keep < 0 or self.kv_retry_budget < 0 or \
+                self.auto_resume < 0:
+            raise ValueError("ckpt_keep / kv_retry_budget / auto_resume "
+                             "must be >= 0")
         if self.mode == "async" and self.publish_every > max(self.staleness_limit, 1):
             # Followers only ever see published versions: a publish gap
             # wider than the staleness window makes EVERY follower gradient

@@ -402,6 +402,21 @@ class DataLoader:
                 self._epoch += 1
                 self._epoch_iter = None
 
+    def fast_forward(self, n_batches: int) -> None:
+        """Position the stream as if ``n_batches`` had already been drawn —
+        the resume-determinism contract: a run restored at step k must see
+        the SAME batch at step k+1 an uninterrupted run would. Epochs are
+        seeked directly (shuffle order is a pure function of the epoch
+        index); the remainder is consumed batch-by-batch so the
+        augmentation rng stream stays sequence-aligned."""
+        per_epoch = len(self)
+        if n_batches <= 0 or per_epoch <= 0:
+            return
+        self._epoch = n_batches // per_epoch
+        self._epoch_iter = None
+        for _ in range(n_batches % per_epoch):
+            self.next_batch()
+
 
 def prepare_data(cfg, host_id: int = 0, num_hosts: int = 1,
                  download: bool = False) -> Tuple[DataLoader, DataLoader]:

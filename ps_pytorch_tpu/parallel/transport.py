@@ -37,6 +37,7 @@ import jax
 import numpy as np
 
 from ps_pytorch_tpu.compression import g_compress, g_decompress
+from ps_pytorch_tpu.resilience.retry import is_retryable
 from ps_pytorch_tpu.telemetry.trace import span as _span
 
 _CHUNK = 1 << 18  # 256 KiB of base64 text per KV value
@@ -85,6 +86,7 @@ class KVPytreeChannel:
         self.bytes_in = 0           # armoured bytes read (cumulative)
         self.last_publish_bytes = 0
         self.publishes = 0
+        self.read_errors = 0        # transient read failures tolerated
 
     # ---- writer side ----
     def publish(self, version: int, tree: Any, meta: Optional[dict] = None) -> None:
@@ -122,32 +124,53 @@ class KVPytreeChannel:
         self.kv.delete(f"{self.prefix}/{version}/meta")
 
     # ---- reader side ----
+    #
+    # Readers poll: a TRANSIENT failure (retry budget exhausted on a flaky
+    # coordination service, injected kv_drop) on the read leg is tolerated
+    # as "nothing this poll" — counted in read_errors, retried naturally on
+    # the next poll. Writes stay strict: a lost publish must surface.
     def latest_version(self) -> Optional[int]:
-        v = self.kv.get(f"{self.prefix}/ver")
+        try:
+            v = self.kv.get(f"{self.prefix}/ver")
+        except Exception as e:
+            if not is_retryable(e):
+                raise
+            self.read_errors += 1
+            return None
         return None if v is None else int(v)
 
     def read(self, version: Optional[int] = None) -> Optional[Tuple[int, Any, dict]]:
         """-> (version, tree, meta) or None if nothing published / already
-        GC'd. Reading the pointer's current target is race-free (see module
+        GC'd (or a transient KV failure this poll — see reader-side note).
+        Reading the pointer's current target is race-free (see module
         docstring)."""
         with _span("wire_read", channel=self.prefix):
-            if version is None:
-                version = self.latest_version()
-                if version is None:
-                    return None
-            meta_s = self.kv.get(f"{self.prefix}/{version}/meta")
-            if meta_s is None:
+            try:
+                return self._read(version)
+            except Exception as e:
+                if not is_retryable(e):
+                    raise
+                self.read_errors += 1
                 return None
-            meta = json.loads(meta_s)
-            leaves = []
-            for l_idx, n in enumerate(meta["chunks"]):
-                chunks = [self.kv.get(f"{self.prefix}/{version}/{l_idx}/{c_idx}")
-                          for c_idx in range(n)]
-                if any(c is None for c in chunks):
-                    return None  # concurrently GC'd (reader was very stale)
-                self.bytes_in += sum(len(c) for c in chunks)
-                leaves.append(_decode_leaf(chunks))
-            return version, jax.tree.unflatten(self.treedef, leaves), meta
+
+    def _read(self, version: Optional[int]) -> Optional[Tuple[int, Any, dict]]:
+        if version is None:
+            version = self.latest_version()
+            if version is None:
+                return None
+        meta_s = self.kv.get(f"{self.prefix}/{version}/meta")
+        if meta_s is None:
+            return None
+        meta = json.loads(meta_s)
+        leaves = []
+        for l_idx, n in enumerate(meta["chunks"]):
+            chunks = [self.kv.get(f"{self.prefix}/{version}/{l_idx}/{c_idx}")
+                      for c_idx in range(n)]
+            if any(c is None for c in chunks):
+                return None  # concurrently GC'd (reader was very stale)
+            self.bytes_in += sum(len(c) for c in chunks)
+            leaves.append(_decode_leaf(chunks))
+        return version, jax.tree.unflatten(self.treedef, leaves), meta
 
 
 class KVGradientTransport:
@@ -209,6 +232,7 @@ class KVGradientTransport:
             "wire_bytes_in": sum(c.bytes_in for c in chans),
             "param_publishes": self.param_ch.publishes,
             "last_param_publish_bytes": self.param_ch.last_publish_bytes,
+            "wire_read_errors": sum(c.read_errors for c in chans),
         }
 
     # ---- run control ----

@@ -1,0 +1,60 @@
+"""Fault-tolerance layer: deterministic fault injection (faults.py),
+jittered-backoff retries with a budget (retry.py), heartbeat liveness
+(heartbeat.py), and crash auto-resume + preemption handling (autoresume.py).
+
+This package is deliberately a LEAF — stdlib + numpy only, no imports from
+the rest of the framework — so the control plane (runtime/coordinator.py),
+the wire (parallel/transport.py), and the trainers can all pull it in
+without cycles, and the chaos tests can drive every piece with a fake
+clock and an in-process dict KV.
+"""
+
+from ps_pytorch_tpu.resilience.autoresume import (  # noqa: F401
+    PreemptionGuard, run_with_auto_resume,
+)
+from ps_pytorch_tpu.resilience.faults import (  # noqa: F401
+    FaultInjector, FaultyKV, InjectedCrash, ManualClock, TransientKVError,
+    corrupt_file, parse_fault_spec,
+)
+from ps_pytorch_tpu.resilience.heartbeat import (  # noqa: F401
+    Heartbeat, LivenessMonitor,
+)
+from ps_pytorch_tpu.resilience.retry import (  # noqa: F401
+    RetryBudget, RetryingKV, RetryPolicy, call_with_retry, is_retryable,
+)
+
+
+def wrap_kv(kv, cfg, process_index: int = 0, clock=None, sleep=None):
+    """Apply the configured resilience shims around a KV store.
+
+    Order matters: the fault plane sits INSIDE the retry plane, so injected
+    transient errors exercise the same recovery path real coordination-
+    service hiccups do. Returns ``(kv, injector, retrier)`` — injector /
+    retrier are None when the corresponding knob is off.
+    """
+    injector = None
+    if getattr(cfg, "fault_spec", ""):
+        injector = FaultInjector(cfg.fault_spec, process_index=process_index,
+                                 clock=clock, sleep=sleep)
+    return wrap_kv_with(kv, cfg, injector, clock=clock, sleep=sleep)
+
+
+def wrap_kv_with(kv, cfg, injector, clock=None, sleep=None):
+    """Like :func:`wrap_kv` but with a caller-owned injector (the auto-resume
+    loop keeps ONE injector alive across trainer restarts so once-only
+    faults stay fired)."""
+    if injector is not None:
+        kv = injector.wrap_kv(kv)
+    retrier = None
+    attempts = int(getattr(cfg, "kv_retry_attempts", 1) or 1)
+    if attempts > 1:
+        policy = RetryPolicy(
+            max_attempts=attempts,
+            base_s=float(getattr(cfg, "kv_retry_base_s", 0.05)),
+            seed=int(getattr(cfg, "seed", 0)))
+        budget = int(getattr(cfg, "kv_retry_budget", 0) or 0)
+        retrier = RetryingKV(kv, policy,
+                             budget=RetryBudget(budget) if budget else None,
+                             clock=clock, sleep=sleep)
+        kv = retrier
+    return kv, injector, retrier

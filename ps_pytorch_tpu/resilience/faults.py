@@ -1,0 +1,265 @@
+"""Deterministic fault injection — the chaos plane the reference never had.
+
+The reference's fault tolerance was only ever exercised by organic EC2
+noise (SURVEY §5.3); none of its failure paths were testable on demand.
+Here every failure mode the runtime claims to survive is INJECTABLE from a
+seeded spec, so the chaos tests are deterministic and the same drills run
+from the CLI (``--fault-spec``) against a real cluster.
+
+Spec grammar (``;``-separated faults, each ``kind:key=val,key=val``):
+
+    kv_drop:p=0.05,seed=7[,op=set|get|delete]
+        Each matching KV op independently raises a transient
+        ``UNAVAILABLE`` error with probability ``p`` (before any state
+        changes — a dropped set writes nothing). The retry plane
+        (retry.py) is what turns these into survived hiccups.
+    kv_delay:p=0.1,s=0.02,seed=3[,op=...]
+        Matching ops sleep ``s`` seconds with probability ``p`` — the
+        slow-control-plane half of the failure model.
+    replica_crash:r=0,step=40
+        Process ``r`` raises :class:`InjectedCrash` at the top of step
+        ``step`` — once per injector lifetime, so an auto-resumed run
+        (which shares the injector) does not crash again at the same step.
+    ckpt_corrupt:step=20[,mode=truncate|flip]
+        The committed checkpoint for ``step`` is corrupted right after the
+        atomic rename (truncate: state.msgpack halved; flip: one byte
+        XORed) — the torn/bit-rotted artifact the manifest verification
+        must catch. Fires once.
+
+Drop/delay decisions come from ``numpy.default_rng(seed + 10007 * pid)``:
+reproducible per process, uncorrelated across processes.
+"""
+
+import os
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+_KINDS = ("kv_drop", "kv_delay", "replica_crash", "ckpt_corrupt")
+_KV_OPS = ("set", "get", "delete")
+
+
+class TransientKVError(ConnectionError):
+    """Injected coordination-service hiccup; always classified retryable
+    (retry.is_retryable) — the message carries UNAVAILABLE on purpose so
+    the textual classifier treats real and injected faults identically."""
+
+
+class InjectedCrash(RuntimeError):
+    """A replica_crash fault firing — the auto-resume loop's signal to
+    rebuild the trainer from the latest valid checkpoint."""
+
+
+class ManualClock:
+    """Fake monotonic clock + sleep for deterministic, real-time-free
+    tests: ``sleep`` just advances ``now`` and records the request."""
+
+    def __init__(self, start: float = 0.0):
+        self.now = float(start)
+        self.sleeps: List[float] = []
+
+    def time(self) -> float:
+        return self.now
+
+    def sleep(self, seconds: float) -> None:
+        self.sleeps.append(float(seconds))
+        self.now += float(seconds)
+
+    def advance(self, seconds: float) -> None:
+        self.now += float(seconds)
+
+
+def _parse_value(s: str) -> Any:
+    try:
+        return int(s)
+    except ValueError:
+        pass
+    try:
+        return float(s)
+    except ValueError:
+        return s
+
+
+def parse_fault_spec(spec: str) -> List[Dict[str, Any]]:
+    """``"kind:k=v,...;kind:..."`` -> list of {"kind": ..., params}.
+    Raises ValueError on unknown kinds/params — config-time, not
+    mid-chaos."""
+    faults = []
+    for part in (spec or "").split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        kind, _, rest = part.partition(":")
+        kind = kind.strip()
+        if kind not in _KINDS:
+            raise ValueError(f"unknown fault kind {kind!r} "
+                             f"(one of {', '.join(_KINDS)})")
+        params: Dict[str, Any] = {"kind": kind}
+        for kv in rest.split(","):
+            kv = kv.strip()
+            if not kv:
+                continue
+            k, sep, v = kv.partition("=")
+            if not sep:
+                raise ValueError(f"fault param {kv!r} is not key=value "
+                                 f"(in {part!r})")
+            params[k.strip()] = _parse_value(v.strip())
+        _validate(params, part)
+        faults.append(params)
+    return faults
+
+
+def _validate(p: Dict[str, Any], part: str) -> None:
+    kind = p["kind"]
+    if kind in ("kv_drop", "kv_delay"):
+        prob = p.get("p")
+        if not isinstance(prob, (int, float)) or not 0 <= prob <= 1:
+            raise ValueError(f"{kind} needs p in [0,1] (got {part!r})")
+        if "op" in p and p["op"] not in _KV_OPS:
+            raise ValueError(f"{kind} op must be one of {_KV_OPS} "
+                             f"(got {part!r})")
+        if kind == "kv_delay" and not isinstance(p.get("s"), (int, float)):
+            raise ValueError(f"kv_delay needs s=<seconds> (got {part!r})")
+    elif kind == "replica_crash":
+        if not isinstance(p.get("step"), int):
+            raise ValueError(f"replica_crash needs step=<int> (got {part!r})")
+        p.setdefault("r", 0)
+    elif kind == "ckpt_corrupt":
+        if not isinstance(p.get("step"), int):
+            raise ValueError(f"ckpt_corrupt needs step=<int> (got {part!r})")
+        if p.setdefault("mode", "flip") not in ("flip", "truncate"):
+            raise ValueError(f"ckpt_corrupt mode must be flip|truncate "
+                             f"(got {part!r})")
+
+
+class FaultyKV:
+    """KVStore-shaped shim injecting drops/delays ahead of the real store.
+
+    Duck-typed on purpose (set/get/delete), so it wraps the in-process
+    dict KV, DistributedKV, or another shim identically.
+    """
+
+    def __init__(self, inner, faults: List[Dict[str, Any]],
+                 injector: "FaultInjector", sleep: Callable[[float], None]):
+        self.inner = inner
+        self._faults = faults
+        self._inj = injector
+        self._sleep = sleep
+        # One stream per fault entry: drop and delay patterns are
+        # independent and each reproducible from its own seed.
+        self._rngs = [np.random.default_rng(
+            int(f.get("seed", 0)) + 10007 * injector.process_index)
+            for f in faults]
+
+    def _roll(self, op: str) -> None:
+        for f, rng in zip(self._faults, self._rngs):
+            if f.get("op") is not None and f["op"] != op:
+                continue
+            if rng.random() >= f["p"]:
+                continue
+            if f["kind"] == "kv_drop":
+                self._inj.counters["kv_drops"] += 1
+                raise TransientKVError(
+                    f"UNAVAILABLE: injected kv_drop on {op}")
+            self._inj.counters["kv_delays"] += 1
+            self._sleep(float(f["s"]))
+
+    def set(self, key: str, value: str) -> None:
+        self._roll("set")
+        self.inner.set(key, value)
+
+    def get(self, key: str, default: Optional[str] = None) -> Optional[str]:
+        self._roll("get")
+        return self.inner.get(key, default)
+
+    def delete(self, key: str) -> None:
+        self._roll("delete")
+        self.inner.delete(key)
+
+
+class FaultInjector:
+    """One injector per process, owning the parsed spec, the fired-fault
+    memory, and the fault counters the telemetry plane reports.
+
+    Survives trainer restarts: the auto-resume loop constructs it once and
+    threads it into each rebuilt trainer, so once-only faults
+    (replica_crash, ckpt_corrupt) do not re-fire after recovery.
+    """
+
+    def __init__(self, spec: str, process_index: int = 0,
+                 clock: Optional[Callable[[], float]] = None,
+                 sleep: Optional[Callable[[float], None]] = None):
+        import time
+        self.spec = spec
+        self.faults = parse_fault_spec(spec)
+        self.process_index = int(process_index)
+        self.clock = clock or time.monotonic
+        self.sleep = sleep or time.sleep
+        self._fired = set()
+        self.counters: Dict[str, int] = {
+            "kv_drops": 0, "kv_delays": 0, "crashes": 0,
+            "ckpt_corruptions": 0}
+
+    # ---- KV plane ----
+    @property
+    def has_kv_faults(self) -> bool:
+        return any(f["kind"] in ("kv_drop", "kv_delay") for f in self.faults)
+
+    def wrap_kv(self, kv):
+        kv_faults = [f for f in self.faults
+                     if f["kind"] in ("kv_drop", "kv_delay")]
+        if not kv_faults:
+            return kv
+        return FaultyKV(kv, kv_faults, self, self.sleep)
+
+    # ---- step loop plane ----
+    def maybe_crash(self, step: int) -> None:
+        """Raise InjectedCrash when a replica_crash fault matches this
+        process and step (once). Call at the top of the step loop."""
+        for i, f in enumerate(self.faults):
+            if f["kind"] != "replica_crash" or ("crash", i) in self._fired:
+                continue
+            if f["r"] == self.process_index and step >= f["step"]:
+                self._fired.add(("crash", i))
+                self.counters["crashes"] += 1
+                raise InjectedCrash(
+                    f"injected replica_crash r={f['r']} at step {step}")
+
+    # ---- checkpoint plane ----
+    def after_checkpoint(self, train_dir: str, step: int) -> None:
+        """Corrupt the just-committed checkpoint when a ckpt_corrupt fault
+        matches ``step`` (once) — simulates bit-rot/torn-write AFTER the
+        atomic rename, which is exactly what the manifest must catch."""
+        for i, f in enumerate(self.faults):
+            if f["kind"] != "ckpt_corrupt" or ("ckpt", i) in self._fired:
+                continue
+            if step >= f["step"]:
+                self._fired.add(("ckpt", i))
+                path = os.path.join(train_dir, f"model_step_{step}",
+                                    "state.msgpack")
+                if corrupt_file(path, mode=f["mode"]):
+                    self.counters["ckpt_corruptions"] += 1
+
+    def snapshot(self) -> Dict[str, int]:
+        return dict(self.counters)
+
+
+def corrupt_file(path: str, mode: str = "flip") -> bool:
+    """Damage ``path`` in place (test/chaos helper). flip: XOR one mid-file
+    byte; truncate: keep the first half. Returns False if the file is
+    missing/empty (nothing to corrupt)."""
+    try:
+        with open(path, "rb") as f:
+            blob = f.read()
+    except OSError:
+        return False
+    if not blob:
+        return False
+    if mode == "truncate":
+        blob = blob[:len(blob) // 2]
+    else:
+        mid = len(blob) // 2
+        blob = blob[:mid] + bytes([blob[mid] ^ 0xFF]) + blob[mid + 1:]
+    with open(path, "wb") as f:
+        f.write(blob)
+    return True

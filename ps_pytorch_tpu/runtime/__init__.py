@@ -1,5 +1,7 @@
 from ps_pytorch_tpu.runtime.checkpoint import (  # noqa: F401
-    save_checkpoint, load_checkpoint, latest_step, checkpoint_path,
+    CheckpointCorruptError, checkpoint_path, latest_step, latest_valid_step,
+    load_checkpoint, load_latest_valid, prune_checkpoints, save_checkpoint,
+    verify_checkpoint,
 )
 from ps_pytorch_tpu.runtime.coordinator import Coordinator  # noqa: F401
 from ps_pytorch_tpu.runtime.trainer import Trainer  # noqa: F401

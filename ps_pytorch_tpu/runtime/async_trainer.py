@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ps_pytorch_tpu import resilience
 from ps_pytorch_tpu.config import TrainConfig
 from ps_pytorch_tpu.data.datasets import DataLoader, load_arrays, sample_shape
 from ps_pytorch_tpu.models import build_model
@@ -87,6 +88,12 @@ class AsyncTrainer:
 
         if kv is None:
             kv = DistributedKV() if self.n > 1 else KVStore()
+        # Resilience shims around the control plane: seeded fault injection
+        # inside (when --fault-spec names kv faults), jittered-backoff
+        # retries outside — the transport and aggregator see one hardened
+        # KV without knowing either layer exists.
+        kv, self.injector, self._retrier = resilience.wrap_kv(
+            kv, cfg, process_index=self.pid)
         # Wire format honors the same flags as the in-process aggregator
         # (--compress-grad / --grad-codec): off -> raw npy framing;
         # blosc -> C++ lossless; int8 -> on-device Pallas quantization, the
@@ -171,13 +178,19 @@ class AsyncTrainer:
                              config_json=self.cfg.to_json(),
                              compress=self.cfg.compress_grad,
                              codec_level=self.cfg.codec_level)
+        if self.injector is not None:
+            self.injector.after_checkpoint(self.cfg.train_dir, self.version)
+        if self.cfg.ckpt_keep > 0:
+            ckpt.prune_checkpoints(self.cfg.train_dir, self.cfg.ckpt_keep)
 
     def _maybe_resume(self) -> bool:
-        step = ckpt.latest_step(self.cfg.train_dir)
-        if step is None:
+        if ckpt.latest_step(self.cfg.train_dir) is None:
             return False
-        state, meta, _ = ckpt.load_checkpoint(
-            self.cfg.train_dir, step, jax.device_get(self._as_train_state()))
+        got = ckpt.load_latest_valid(
+            self.cfg.train_dir, jax.device_get(self._as_train_state()))
+        if got is None:
+            return False
+        state, meta, _, step = got
         # Checkpoints come back as host numpy; restore device residency once.
         self.params = jax.device_put(state.params, self._rep)
         self.opt_state = jax.device_put(state.opt_state, self._rep)
@@ -315,6 +328,10 @@ class AsyncTrainer:
                     max_own: int) -> None:
         while own_steps < max_own:
             t0 = time.monotonic()
+            if self.injector is not None:
+                # Keyed on this process's own step counter (the async loop
+                # has no global step on followers).
+                self.injector.maybe_crash(own_steps + 1)
             done = self.transport.done()
             if done is not None and (not self.leader):
                 break
@@ -338,6 +355,16 @@ class AsyncTrainer:
             step_for_log = self.version if self.leader else own_steps
             if step_for_log and step_for_log % cfg.log_every == 0:
                 wire = self.transport.wire_stats()
+                extra = {}
+                if self.injector is not None:
+                    extra.update(self.injector.snapshot())
+                if self._retrier is not None:
+                    s = self._retrier.snapshot()
+                    # Schema gate: vanilla runs only grow resilience columns
+                    # once the retry plane actually absorbed an error.
+                    if self.injector is not None or s["kv_retries"] or \
+                            s["kv_giveups"]:
+                        extra.update(s)
                 self.metrics.log_step(
                     step_for_log, 0, loss=m["loss"], acc=m["acc"],
                     participating=float(used),
@@ -345,7 +372,7 @@ class AsyncTrainer:
                     applied=self.applied, dropped_stale=self.dropped_stale,
                     wire_bytes_out=wire["wire_bytes_out"],
                     wire_bytes_in=wire["wire_bytes_in"],
-                    publish_s=round(self.last_publish_s, 4))
+                    publish_s=round(self.last_publish_s, 4), **extra)
         if self.leader:
             if cfg.eval_freq > 0 and self.version % cfg.eval_freq != 0:
                 self._checkpoint()

@@ -96,7 +96,8 @@ class Coordinator:
     def __init__(self, n_replicas: int, mode: str = "sync",
                  num_aggregate: int = 0, kill_threshold: float = 0.0,
                  kv: Optional[KVStore] = None, run_id: str = "run",
-                 leader: bool = True, mask_gc_window: int = 50):
+                 leader: bool = True, mask_gc_window: int = 50,
+                 liveness=None):
         if mode not in ("sync", "kofn", "async"):
             raise ValueError(f"unknown mode {mode!r}")
         if mode == "kofn" and not (0 < num_aggregate <= n_replicas):
@@ -110,6 +111,12 @@ class Coordinator:
         self.run_id = run_id
         self.leader = leader
         self.mask_gc_window = max(int(mask_gc_window), 2)
+        # Optional resilience/heartbeat.LivenessMonitor (leader-side): folds
+        # missed-heartbeat liveness into the mask — a CRASHED host is a
+        # different failure than a SLOW one (kofn/deadline act on durations
+        # a dead host stops reporting).
+        self.liveness = liveness
+        self.stats: Dict[str, int] = {"mask_changes": 0}
         self._last_printed_mask: Optional[str] = None
         # last observed per-replica step duration (telemetry; seconds)
         self._last_duration = np.zeros(n_replicas, np.float64)
@@ -188,6 +195,8 @@ class Coordinator:
             desc = json.dumps(mask.astype(int).tolist())
             if desc != self._last_printed_mask:
                 print(f"MASK step {step} {desc}")
+                if self._last_printed_mask is not None:
+                    self.stats["mask_changes"] += 1
                 self._last_printed_mask = desc
             self.kv.set(key, json.dumps(mask.tolist()))
             # GC with a WIDE window, not step-2: JAX dispatch is async and
@@ -201,8 +210,24 @@ class Coordinator:
             return mask
 
     def _decide_mask(self) -> np.ndarray:
+        # Kills are a KV protocol (tag-77 equivalent): pull every replica's
+        # kill key so a kill issued on ANY process reaches the leader's
+        # mask, not just kills issued through this object (the local
+        # ``_killed`` array alone missed cross-process kills).
+        self._refresh_kills()
         mask = (~self._killed).astype(np.float32)
+        if self.liveness is not None:
+            # Missed-heartbeat eviction (graceful degradation, distinct
+            # from kofn slowness); a fully-dead view falls through to the
+            # never-wedge fallback below rather than masking everyone.
+            alive = np.asarray(self.liveness.alive_mask(), bool)
+            if alive.any():
+                mask *= alive.astype(np.float32)
         if self.mode == "sync":
+            if mask.sum() == 0:
+                mask = (~self._killed).astype(np.float32)
+                if mask.sum() == 0:
+                    mask = np.ones(self.n, np.float32)
             return mask
         dur = self.pull_durations()
         if self.kill_threshold > 0:
@@ -236,3 +261,12 @@ class Coordinator:
 
     def is_killed(self, replica: int) -> bool:
         return self.kv.get(f"{self.run_id}/kill/{replica}") == "1"
+
+    def _refresh_kills(self) -> None:
+        """Fold KV kill keys into the local kill set. Kills are permanent
+        (matching the reference's tag-77 semantics: a killed worker never
+        rejoins), so only 0->1 transitions are read."""
+        for r in range(self.n):
+            if not self._killed[r] and \
+                    self.kv.get(f"{self.run_id}/kill/{r}") == "1":
+                self._killed[r] = True

@@ -28,6 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ps_pytorch_tpu import resilience
 from ps_pytorch_tpu.config import TrainConfig
 from ps_pytorch_tpu.data.text import TokenLoader
 from ps_pytorch_tpu.models.transformer import (
@@ -185,6 +186,12 @@ class LMTrainer:
         self._n_chips = n
         self._peak_per_chip = aggregate_peak_flops(devices)
         self.start_step = 0
+        # Fault plane (same spec/grammar as the CNN trainer): step-keyed
+        # crashes + post-commit checkpoint corruption for resilience drills.
+        self.injector = None
+        if cfg.fault_spec:
+            self.injector = resilience.FaultInjector(
+                cfg.fault_spec, process_index=jax.process_index())
 
     # ---- checkpoint/resume (same on-disk contract as the CNN Trainer) ----
     def _checkpoint(self, step: int) -> None:
@@ -200,31 +207,38 @@ class LMTrainer:
                              config_json=self.cfg.to_json(),
                              compress=self.cfg.compress_grad,
                              codec_level=self.cfg.codec_level)
+        if self.injector is not None:
+            self.injector.after_checkpoint(self.cfg.train_dir, step)
+        if self.cfg.ckpt_keep > 0:
+            ckpt.prune_checkpoints(self.cfg.train_dir, self.cfg.ckpt_keep)
 
     def maybe_resume(self) -> bool:
-        step = ckpt.latest_step(self.cfg.train_dir)
-        if step is None:
+        if ckpt.latest_step(self.cfg.train_dir) is None:
             return False
         # Collective gather for the restore template, mirroring
         # _checkpoint: tp/pp/ep shard state across hosts, where a plain
         # device_get raises on non-addressable shards.
         template = dist.all_replicated(self.mesh, self.state)
         try:
+            # Valid-latest restore: manifest-failing (corrupt) checkpoints
+            # are skipped back to the previous committed step.
             # migrate: checkpoints written before the q/k/v projection
             # split (packed [d,3d] Dense_0, Block Dense_0..3) are rewritten
             # to the current layout in-memory — exact column split, see
             # models/transformer.py:migrate_packed_qkv.
-            state, meta, config_json = ckpt.load_checkpoint(
-                self.cfg.train_dir, step, template,
-                migrate=migrate_packed_qkv)
+            got = ckpt.load_latest_valid(
+                self.cfg.train_dir, template, migrate=migrate_packed_qkv)
         except Exception as e:
             # Most likely a non-LM (CNN) checkpoint sharing the default
             # ./train_dir — surface that instead of a msgpack key error.
             raise ValueError(
-                f"could not restore step {step} from {self.cfg.train_dir} "
+                f"could not restore a checkpoint from {self.cfg.train_dir} "
                 f"into the LM state (a train.py checkpoint in the same "
                 f"train_dir? use a separate --train-dir or "
                 f"--no-resume): {type(e).__name__}: {e}") from e
+        if got is None:
+            return False
+        state, meta, config_json, _ = got
         # A CNN checkpoint in the same train_dir would fail deep inside
         # deserialization; check the saved config's model geometry first
         # and fail with an actionable message instead.
@@ -268,6 +282,8 @@ class LMTrainer:
         try:
             while step < cfg.max_steps:
                 step += 1
+                if self.injector is not None:
+                    self.injector.maybe_crash(step)
                 t0 = time.monotonic()
                 with self.tracer.span("data_wait", step=step):
                     tokens = self.train_loader.next_batch()

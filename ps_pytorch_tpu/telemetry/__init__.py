@@ -6,9 +6,9 @@ from ps_pytorch_tpu.telemetry.aggregate import (  # noqa: F401
     TelemetryAggregator, read_timeline,
 )
 from ps_pytorch_tpu.telemetry.registry import (  # noqa: F401
-    MetricSpec, Registry, aggregate_peak_flops, compute_mfu,
-    data_stall_fraction, derive_step_record, device_memory_record,
-    step_flops_of,
+    RESILIENCE_COUNTERS, MetricSpec, Registry, aggregate_peak_flops,
+    compute_mfu, data_stall_fraction, declare_resilience_metrics,
+    derive_step_record, device_memory_record, step_flops_of,
 )
 from ps_pytorch_tpu.telemetry.trace import (  # noqa: F401
     Tracer, get_default_tracer, set_default_tracer, span,

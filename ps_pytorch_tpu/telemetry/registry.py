@@ -102,6 +102,34 @@ class Registry:
             return dict(self._specs)
 
 
+# ---- resilience counter contract ----
+#
+# The fault/retry/liveness planes (ps_pytorch_tpu/resilience/) each expose a
+# snapshot() of cumulative counters; the trainers merge them into the step
+# record (gated — only when a resilience plane is active) and
+# tools/analyze.py's `faults` mode reads them back. This tuple is the one
+# reviewable list of those fields: (name, unit, help).
+RESILIENCE_COUNTERS = (
+    ("kv_drops", "ops", "injected KV drops raised as transient errors"),
+    ("kv_delays", "ops", "injected KV delays applied"),
+    ("crashes", "events", "injected replica crashes fired"),
+    ("ckpt_corruptions", "events",
+     "injected post-commit checkpoint corruptions"),
+    ("kv_retries", "ops", "KV ops retried after a transient error"),
+    ("kv_giveups", "ops", "KV ops failed after retries/budget ran out"),
+    ("evictions", "events", "replicas evicted for missed heartbeats"),
+    ("readmissions", "events", "evicted replicas readmitted on recovery"),
+    ("mask_changes", "events", "leader participation-mask changes"),
+)
+
+
+def declare_resilience_metrics(registry: Registry) -> Registry:
+    """Declare every resilience counter on ``registry`` (all monotonic)."""
+    for name, unit, help_ in RESILIENCE_COUNTERS:
+        registry.counter(name, unit=unit, help=help_)
+    return registry
+
+
 # ---- derived per-step arithmetic (one definition; PERF.md cites this) ----
 
 def compute_mfu(flops_per_step: Optional[int], step_time_s: float,

@@ -24,6 +24,15 @@ time actually goes, per phase; ``--json`` additionally emits the
 
     python -m ps_pytorch_tpu.tools.analyze timeline /tmp/m.jsonl
     python -m ps_pytorch_tpu.tools.analyze timeline run.jsonl.timeline --json
+
+Faults mode summarizes a resilience run: the trainers merge the fault/
+retry/liveness counters (telemetry/registry.RESILIENCE_COUNTERS) into the
+step records whenever a resilience plane is active; this mode folds them
+back into one table (counters are cumulative — the max across records is
+the run total) plus the steps covered and final mask changes:
+
+    python -m ps_pytorch_tpu.tools.analyze faults /tmp/m.jsonl
+    python -m ps_pytorch_tpu.tools.analyze faults chaos.jsonl --json
 """
 
 import argparse
@@ -187,6 +196,63 @@ def timeline_main(args, parser) -> int:
     return 0
 
 
+# ---- faults mode (resilience counter summary) ----
+
+def fault_summary(rows: List[dict]) -> dict:
+    """Step records -> run-level resilience summary. Counters are
+    CUMULATIVE at emission time, so the run total of each is its max over
+    the records (records may come from several files/processes; max still
+    holds per counter because every emitter only grows them)."""
+    from ps_pytorch_tpu.telemetry.registry import RESILIENCE_COUNTERS
+    steps = sorted({r["step"] for r in rows if "step" in r})
+    if not steps:
+        raise ValueError("no step records")
+    counters = {}
+    for name, _, _ in RESILIENCE_COUNTERS:
+        vals = [r[name] for r in rows if name in r]
+        if vals:
+            counters[name] = max(int(v) for v in vals)
+    # resilience may also arrive nested (timeline records publish it as one
+    # sub-object rather than flat columns).
+    for r in rows:
+        sub = r.get("resilience")
+        if isinstance(sub, dict):
+            for name, _, _ in RESILIENCE_COUNTERS:
+                if name in sub:
+                    counters[name] = max(counters.get(name, 0),
+                                         int(sub[name]))
+    return {"steps": len(steps), "first_step": steps[0],
+            "last_step": steps[-1], "counters": counters,
+            "clean": not any(counters.values())}
+
+
+def faults_markdown(summary: dict) -> str:
+    head = "| counter | total |"
+    sep = "|---|---|"
+    body = [f"| {k} | {v} |" for k, v in sorted(summary["counters"].items())]
+    if not body:
+        body = ["| (no resilience counters in records) | - |"]
+    tail = (f"\nsteps {summary['first_step']}..{summary['last_step']} "
+            f"({summary['steps']} records) clean={summary['clean']}")
+    return "\n".join([head, sep] + body) + tail
+
+
+def faults_main(args, parser) -> int:
+    files: List[str] = []
+    for pattern in args.runs:
+        files.extend(sorted(glob.glob(pattern)) or
+                     parser.error(f"no files match {pattern!r}") or [])
+    rows = [r for path in files for r in read_records(path)]
+    if not rows:
+        parser.error(f"no step records in {files}")
+    summary = fault_summary(rows)
+    if args.json:
+        print(json.dumps(summary))
+    else:
+        print(faults_markdown(summary))
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("runs", nargs="+",
@@ -200,6 +266,9 @@ def main(argv=None) -> int:
     if args.runs[0] == "timeline":
         args.runs = args.runs[1:] or p.error("timeline mode needs FILE...")
         return timeline_main(args, p)
+    if args.runs[0] == "faults":
+        args.runs = args.runs[1:] or p.error("faults mode needs FILE...")
+        return faults_main(args, p)
 
     runs: Dict[str, List[str]] = {}
     for spec in args.runs:

@@ -227,6 +227,19 @@ def collect(repo: str):
                     100 * (top.get("frac_of_step") or 0)),
                 "platform": d.get("platform", "host"),
                 "ok": bool(phases) and "_parse_error" not in d})
+    p = _newest("RESILIENCE_r[0-9]*.json", repo)
+    if p:
+        # Chaos-drill evidence (tools/analyze.py faults mode + the E2E
+        # crash/restore scenario): ok means the drill recovered — resumed
+        # from a valid checkpoint and/or completed under injected faults.
+        d = as_dict(_load(p))
+        c = d.get("counters") or {}
+        add("resilience", p, {
+            "value": d.get("scenario"), "unit": "chaos scenario",
+            "platform": d.get("platform"),
+            "crashes": c.get("crashes"),
+            "kv_retries": c.get("kv_retries"),
+            "ok": d.get("ok") is True and "_parse_error" not in d})
     p = os.path.join(repo, "COPYCHECK.json")
     if os.path.exists(p):
         d = as_dict(_load(p))

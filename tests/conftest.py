@@ -31,6 +31,15 @@ def pytest_configure(config):
         "markers", "slow: long-running integration test (multi-process launch)")
 
 
+def pytest_collection_modifyitems(items):
+    # Chaos/resilience drills build whole trainers and run multi-step
+    # fault-injected loops — by far the most expensive module. Run them
+    # after the core invariants so a time-bounded run reports the
+    # fundamentals first. (Stable sort: relative order inside each group
+    # is unchanged.)
+    items.sort(key=lambda it: it.fspath.basename == "test_resilience.py")
+
+
 @pytest.fixture(scope="session")
 def mesh8():
     from ps_pytorch_tpu.parallel import make_mesh

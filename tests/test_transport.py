@@ -114,7 +114,8 @@ def test_wire_stats_count_armoured_bytes():
     reader = KVGradientTransport(kv, 1, tpl, tpl, run_id="r")
     assert writer.wire_stats() == {"wire_bytes_out": 0, "wire_bytes_in": 0,
                                    "param_publishes": 0,
-                                   "last_param_publish_bytes": 0}
+                                   "last_param_publish_bytes": 0,
+                                   "wire_read_errors": 0}
     writer.submit_grads(0, seq=1, step=0, grads=_tree(1))
     writer.publish_params(1, _tree(2))
     st = writer.wire_stats()

@@ -49,6 +49,40 @@ def main(argv=None) -> int:
                                         fetch_every=cfg.fetch_every)
             print(f"SLICES {cfg.async_slices} x "
                   f"{len(trainer.meshes[0].devices.flat)} devices")
+    elif cfg.auto_resume > 0:
+        # Crash containment: a failed step loop restarts the trainer, which
+        # restores from the latest VALID checkpoint (runtime/checkpoint.py
+        # manifest verification) and fast-forwards the data stream. One
+        # FaultInjector is threaded across restarts so injected once-only
+        # faults (chaos drills) do not re-fire after resume.
+        from ps_pytorch_tpu import resilience
+        import jax
+        injector = None
+        if cfg.fault_spec:
+            injector = resilience.FaultInjector(
+                cfg.fault_spec, process_index=jax.process_index())
+        resume_cfg = cfg if cfg.resume else cfg.replace(resume=1)
+        built = []
+
+        def make_trainer():
+            # First build honours the user's --resume; rebuilds always
+            # resume (that is the whole point of the restart).
+            t = Trainer(resume_cfg if built else cfg, injector=injector)
+            if not built:
+                print(f"MESH data={t.mesh.shape['data']} "
+                      f"model={t.mesh.shape['model']} "
+                      f"devices={len(t.mesh.devices.flat)}")
+            built.append(t)
+            return t
+
+        resilience.run_with_auto_resume(
+            make_trainer, max_restarts=cfg.auto_resume,
+            exceptions=(Exception,))
+        trainer = built[-1]
+        result = trainer.evaluate()
+        print(f"FINAL loss {result['loss']:.6f} prec1 {result['prec1']:.4f} "
+              f"prec5 {result['prec5']:.4f}")
+        return 0
     else:
         trainer = Trainer(cfg)
         print(f"MESH data={trainer.mesh.shape['data']} model={trainer.mesh.shape['model']} "
