@@ -82,6 +82,11 @@ class TrainConfig:
     codec_level: int = 3
     grad_codec: str = "blosc"        # blosc (lossless, native C++) | int8 (on-device Pallas)
 
+    # -- overlapped gradient wire (parallel/buckets.py + transport.py; the
+    #    reference's per-layer send-during-backward, resnet_split.py:25-42) --
+    wire_bucket_mb: float = 4.0      # bucket size target for the async DCN wire; 0 = legacy blocking single-payload schedule (bytes identical either way)
+    wire_workers: int = 4            # encode/decode worker threads per channel; <=1 = no pipelining
+
     # -- LM / long-context surface (train_lm.py; reference has no LM) --
     lm_vocab: int = 256
     lm_d_model: int = 128
@@ -166,6 +171,12 @@ class TrainConfig:
         if self.kv_retry_attempts < 1:
             raise ValueError(f"kv_retry_attempts={self.kv_retry_attempts} "
                              "(must be >= 1; 1 = no retries)")
+        if self.wire_bucket_mb < 0:
+            raise ValueError(f"wire_bucket_mb={self.wire_bucket_mb} "
+                             "(must be >= 0; 0 = blocking wire)")
+        if self.wire_workers < 0:
+            raise ValueError(f"wire_workers={self.wire_workers} "
+                             "(must be >= 0; <=1 = no pipelining)")
         for name in ("heartbeat_interval_s", "heartbeat_timeout_s",
                      "kv_retry_base_s"):
             if getattr(self, name) < 0:

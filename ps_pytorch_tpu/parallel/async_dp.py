@@ -48,7 +48,8 @@ class StaleGradientAggregator:
     def __init__(self, n_slices: int, staleness_limit: int = 4,
                  staleness_decay: float = 0.0, num_aggregate: int = 0,
                  compress: bool = False, codec_level: int = 3,
-                 codec: str = "blosc"):
+                 codec: str = "blosc", wire_bucket_bytes: int = 0,
+                 wire_workers: int = 0):
         if n_slices < 1:
             raise ValueError("need at least one slice")
         if num_aggregate > n_slices:
@@ -68,6 +69,13 @@ class StaleGradientAggregator:
         #          the chip; the TPU-native option the reference had no
         #          equivalent of.
         self.codec = codec
+        # Overlapped DCN leg (--wire-bucket-mb/--wire-workers): the blosc
+        # compress of bucket k runs on worker threads while bucket k+1 is
+        # still finishing on device (parallel/buckets.py). 0 = blocking
+        # whole-tree compress; compressed bytes identical either way.
+        self.wire_bucket_bytes = int(wire_bucket_bytes)
+        self.wire_workers = int(wire_workers)
+        self._executor = None
         # slice_id -> (step, leaves or compressed leaves, treedef)
         self._pool: Dict[int, Tuple[int, List[Any], Any]] = {}
 
@@ -83,14 +91,35 @@ class StaleGradientAggregator:
             leaves = [quantize_int8(l, jax.random.fold_in(key, i))
                       for i, l in enumerate(leaves)]
         elif self.compress:
-            from ps_pytorch_tpu.compression import g_compress
-            leaves = [g_compress(np.asarray(l), level=self.codec_level)
-                      for l in leaves]
+            leaves = self._compress_leaves(leaves)
         # No codec: pool leaves as submitted. In-process callers hand device
         # arrays, which STAY on device (collect's arithmetic then runs there
         # and the averaged gradient never round-trips the host); wire callers
         # hand numpy that was already pulled for decode.
         self._pool[slice_id] = (step, leaves, treedef)
+
+    def _compress_leaves(self, leaves: List[Any]) -> List[bytes]:
+        """The multislice DCN leg, optionally overlapped: per-bucket device
+        sync then pooled blosc compress, so slice grads for bucket k leave
+        the chip while bucket k+1 is still computing."""
+        from ps_pytorch_tpu.compression import g_compress
+        from ps_pytorch_tpu.parallel.buckets import plan_buckets, stream_buckets
+        buckets = plan_buckets(leaves, self.wire_bucket_bytes)
+        pool = None
+        if self.wire_workers > 1 and len(buckets) > 1:
+            if self._executor is None:
+                from concurrent.futures import ThreadPoolExecutor
+                self._executor = ThreadPoolExecutor(
+                    max_workers=self.wire_workers,
+                    thread_name_prefix="agg-wire")
+            pool = self._executor
+        out = stream_buckets(
+            leaves, buckets,
+            lambda b, block: [g_compress(np.asarray(l),
+                                         level=self.codec_level)
+                              for l in block],
+            pool)
+        return [c for block in out for c in block]
 
     def wire_bytes(self) -> int:
         """Bytes currently pooled (what crossed / would cross DCN)."""

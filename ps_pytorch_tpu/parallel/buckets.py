@@ -1,0 +1,109 @@
+"""Size-targeted bucketing of flat pytree leaves — the schedule unit of the
+overlapped gradient wire (parallel/transport.py).
+
+The reference's split-model variant interleaves per-layer backward with
+per-layer gradient sends so communication hides under compute
+(``resnet_split.py:25-42``, ``lenet.py:39-258``). Leaves play the layers'
+role here, but raw leaf granularity is the wrong wire unit: bias vectors
+would pay per-message overhead, big conv kernels would serialize. Buckets
+re-cut the flat-leaf sequence into ~``bucket_bytes`` contiguous spans (the
+DDP gradient-bucketing idiom), preserving flat order so each bucket is
+exactly ``leaves[start:stop]`` and the full pytree round-trips from
+per-bucket pieces by plain concatenation under the channel's treedef.
+
+Bucketing is purely an execution schedule: which leaf lands under which
+chunk key, and the chunk bytes themselves, are identical to the unbucketed
+wire. Only the publish/read ORDER gains structure, which is what lets the
+channel sync, encode, put, and decode bucket k while bucket k+1 is still
+computing.
+"""
+
+from dataclasses import dataclass
+from typing import Any, Callable, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+
+@dataclass(frozen=True)
+class Bucket:
+    """A contiguous span of flat-order leaves: ``leaves[start:stop]``."""
+    index: int
+    start: int
+    stop: int
+    nbytes: int   # sum of member leaves' uncompressed sizes
+
+
+def leaf_nbytes(leaf: Any) -> int:
+    """Uncompressed byte size of a leaf without forcing a device transfer."""
+    nb = getattr(leaf, "nbytes", None)
+    if nb is not None:
+        return int(nb)
+    if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+        return int(np.prod(leaf.shape, dtype=np.int64)
+                   * np.dtype(leaf.dtype).itemsize)
+    return np.asarray(leaf).nbytes
+
+
+def plan_buckets(leaves: Sequence[Any], bucket_bytes: int) -> List[Bucket]:
+    """Greedy contiguous partition of `leaves` into ~bucket_bytes buckets.
+
+    Deterministic in flat-leaf order: a bucket closes once adding the next
+    leaf would push it past the target (a single over-target leaf still
+    gets its own bucket — leaves are never split). ``bucket_bytes <= 0``
+    yields one bucket spanning everything (the blocking schedule).
+    """
+    if not leaves:
+        return []
+    sizes = [leaf_nbytes(l) for l in leaves]
+    if bucket_bytes <= 0:
+        return [Bucket(0, 0, len(leaves), sum(sizes))]
+    buckets: List[Bucket] = []
+    start, acc = 0, 0
+    for i, nb in enumerate(sizes):
+        if i > start and acc + nb > bucket_bytes:
+            buckets.append(Bucket(len(buckets), start, i, acc))
+            start, acc = i, 0
+        acc += nb
+    buckets.append(Bucket(len(buckets), start, len(sizes), acc))
+    return buckets
+
+
+def bucket_counts(buckets: Sequence[Bucket]) -> List[int]:
+    """Per-bucket leaf counts — the compact form shipped in wire meta."""
+    return [b.stop - b.start for b in buckets]
+
+
+def _sync(block: Sequence[Any]) -> None:
+    device = [l for l in block if isinstance(l, jax.Array)]
+    if device:
+        jax.block_until_ready(device)
+
+
+def stream_buckets(leaves: Sequence[Any], buckets: Sequence[Bucket],
+                   fn: Callable[[Bucket, List[Any]], Any],
+                   pool: Optional[Any] = None) -> List[Any]:
+    """Run ``fn(bucket, leaves[start:stop])`` per bucket, each bucket's
+    device values synced (``block_until_ready``, flat order) on the calling
+    thread first. With an executor `pool`, fn runs on worker threads while
+    the caller moves on to sync the NEXT bucket — encode/put for bucket k
+    overlaps device compute for bucket k+1, the paper's per-layer
+    send-during-backward schedule. Without a pool this is a plain serial
+    map (same results, blocking schedule).
+
+    Returns fn results in bucket order; the first worker exception
+    re-raises here, after all submissions.
+    """
+    if pool is None:
+        out = []
+        for b in buckets:
+            block = list(leaves[b.start:b.stop])
+            _sync(block)
+            out.append(fn(b, block))
+        return out
+    futures = []
+    for b in buckets:
+        block = list(leaves[b.start:b.stop])
+        _sync(block)
+        futures.append(pool.submit(fn, b, block))
+    return [f.result() for f in futures]

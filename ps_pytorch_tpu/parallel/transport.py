@@ -26,21 +26,38 @@ base85 (25% size overhead) rather than base64 (33%); chunking keeps every
 value under the coordination service's comfort zone. Channels count the
 bytes they move (``bytes_out``/``bytes_in``) so the async trainers can
 report wire traffic per step instead of asserting it is small.
+
+Overlapped schedule (``bucket_bytes > 0``): leaves are cut into contiguous
+size-targeted buckets (parallel/buckets.py) and the encode pipeline
+(quantize → codec → b85 → chunked put) for bucket k runs on a small worker
+pool while bucket k+1 is still syncing off-device — the JAX analogue of the
+reference's per-layer send-during-backward (``resnet_split.py:25-42``).
+The payload is BITWISE IDENTICAL to the blocking wire: same per-leaf chunk
+keys, same chunk bytes, same ``"chunks"`` meta; bucketing only adds a
+``"buckets"`` meta entry (per-bucket leaf counts) that old readers ignore
+and new readers use to fetch/decode buckets concurrently. The ver pointer
+still moves only after EVERY bucket has committed, so race-free ordering
+and the once-only fault semantics from resilience/ are unchanged.
+``bucket_bytes == 0`` takes the legacy single-payload code path untouched.
 """
 
-import base64
 import io
 import json
+from concurrent.futures import ThreadPoolExecutor
 from typing import Any, List, Optional, Tuple
 
 import jax
 import numpy as np
 
 from ps_pytorch_tpu.compression import g_compress, g_decompress
+from ps_pytorch_tpu.parallel.buckets import (
+    bucket_counts, plan_buckets, stream_buckets,
+)
 from ps_pytorch_tpu.resilience.retry import is_retryable
 from ps_pytorch_tpu.telemetry.trace import span as _span
+from ps_pytorch_tpu.utils.armor import b85decode, b85encode
 
-_CHUNK = 1 << 18  # 256 KiB of base64 text per KV value
+_CHUNK = 1 << 18  # 256 KiB of base85 text per KV value (what bytes_out counts)
 _RAW_MAGIC = b"NPYRAW0:"
 
 
@@ -52,12 +69,12 @@ def _encode_leaf(leaf, level: int, codec: str) -> List[str]:
         raw = _RAW_MAGIC + buf.getvalue()
     else:
         raw = g_compress(np.asarray(leaf), level=level)
-    b85 = base64.b85encode(raw).decode("ascii")
+    b85 = b85encode(raw).decode("ascii")
     return [b85[i:i + _CHUNK] for i in range(0, len(b85), _CHUNK)] or [""]
 
 
 def _decode_leaf(chunks: List[str]) -> np.ndarray:
-    raw = base64.b85decode("".join(chunks).encode("ascii"))
+    raw = b85decode("".join(chunks))
     if raw.startswith(_RAW_MAGIC):
         return np.load(io.BytesIO(raw[len(_RAW_MAGIC):]), allow_pickle=False)
     return g_decompress(raw)
@@ -70,23 +87,39 @@ class KVPytreeChannel:
     ``--compress-grad`` wire format) or 'raw' (uncompressed npy framing,
     the --compress-grad-off contract). Decoding is self-describing either
     way, so mixed readers/writers cannot misinterpret bytes.
+
+    ``bucket_bytes``/``workers``: the overlapped schedule (module
+    docstring). 0 workers or 0 bucket_bytes degrades gracefully — same
+    bytes, blocking order.
     """
 
     def __init__(self, kv, prefix: str, template: Any, level: int = 3,
-                 codec: str = "blosc"):
+                 codec: str = "blosc", bucket_bytes: int = 0,
+                 workers: int = 0):
         if codec not in ("blosc", "raw"):
             raise ValueError(f"unknown channel codec {codec!r} (blosc | raw)")
         self.kv = kv
         self.prefix = prefix
         self.level = level
         self.codec = codec
+        self.bucket_bytes = int(bucket_bytes)
+        self.workers = int(workers)
         leaves, self.treedef = jax.tree.flatten(template)
         self.n_leaves = len(leaves)
         self.bytes_out = 0          # armoured bytes written (cumulative)
         self.bytes_in = 0           # armoured bytes read (cumulative)
         self.last_publish_bytes = 0
+        self.last_publish_bucket_bytes: List[int] = []  # armoured, per bucket
         self.publishes = 0
         self.read_errors = 0        # transient read failures tolerated
+        self._pool: Optional[ThreadPoolExecutor] = None
+
+    def _executor(self) -> ThreadPoolExecutor:
+        if self._pool is None:
+            self._pool = ThreadPoolExecutor(
+                max_workers=max(self.workers, 1),
+                thread_name_prefix=f"wire:{self.prefix}")
+        return self._pool
 
     # ---- writer side ----
     def publish(self, version: int, tree: Any, meta: Optional[dict] = None) -> None:
@@ -94,22 +127,64 @@ class KVPytreeChannel:
             leaves, treedef = jax.tree.flatten(tree)
             if treedef != self.treedef:
                 raise ValueError("published tree structure != channel template")
-            chunk_counts = []
-            nbytes = 0
-            for l_idx, leaf in enumerate(leaves):
-                chunks = _encode_leaf(leaf, self.level, self.codec)
-                chunk_counts.append(len(chunks))
-                nbytes += sum(len(c) for c in chunks)
-                for c_idx, c in enumerate(chunks):
-                    self.kv.set(f"{self.prefix}/{version}/{l_idx}/{c_idx}", c)
-            self.bytes_out += nbytes
-            self.last_publish_bytes = nbytes
+            if self.bucket_bytes > 0:
+                chunk_counts, extra = self._put_bucketed(version, leaves)
+            else:
+                chunk_counts, extra = self._put_serial(version, leaves)
             self.publishes += 1
             self.kv.set(f"{self.prefix}/{version}/meta",
-                        json.dumps({**(meta or {}), "chunks": chunk_counts}))
-            # Pointer moves only after the payload is fully visible.
+                        json.dumps({**(meta or {}), "chunks": chunk_counts,
+                                    **extra}))
+            # Pointer moves only after the payload is fully visible —
+            # in the bucketed schedule that means after the LAST bucket's
+            # worker has committed its chunks.
             self.kv.set(f"{self.prefix}/ver", str(version))
             self._gc(version - 2)
+
+    def _put_serial(self, version: int, leaves: List[Any]):
+        """Legacy blocking wire: leaf-at-a-time encode+put, byte-exact with
+        every payload this channel ever produced before bucketing existed."""
+        chunk_counts = []
+        nbytes = 0
+        for l_idx, leaf in enumerate(leaves):
+            chunks = _encode_leaf(leaf, self.level, self.codec)
+            chunk_counts.append(len(chunks))
+            nbytes += sum(len(c) for c in chunks)
+            for c_idx, c in enumerate(chunks):
+                self.kv.set(f"{self.prefix}/{version}/{l_idx}/{c_idx}", c)
+        self.bytes_out += nbytes
+        self.last_publish_bytes = nbytes
+        self.last_publish_bucket_bytes = [nbytes]
+        return chunk_counts, {}
+
+    def _put_bucketed(self, version: int, leaves: List[Any]):
+        """Overlapped wire: per-bucket sync → pooled encode+put. Same chunk
+        keys and bytes as _put_serial; only the schedule differs."""
+        bks = plan_buckets(leaves, self.bucket_bytes)
+        pool = self._executor() if (self.workers > 1 and len(bks) > 1) else None
+
+        def encode_put(b, block):
+            with _span("wire_encode", channel=self.prefix, bucket=b.index,
+                       leaves=len(block)):
+                texts = [_encode_leaf(l, self.level, self.codec)
+                         for l in block]
+            nbytes = sum(len(c) for chunks in texts for c in chunks)
+            with _span("wire_put", channel=self.prefix, bucket=b.index,
+                       bytes=nbytes):
+                for off, chunks in enumerate(texts):
+                    l_idx = b.start + off
+                    for c_idx, c in enumerate(chunks):
+                        self.kv.set(f"{self.prefix}/{version}/{l_idx}/{c_idx}",
+                                    c)
+            return [len(chunks) for chunks in texts], nbytes
+
+        results = stream_buckets(leaves, bks, encode_put, pool)
+        chunk_counts = [n for counts, _ in results for n in counts]
+        per_bucket = [nb for _, nb in results]
+        self.bytes_out += sum(per_bucket)
+        self.last_publish_bytes = sum(per_bucket)
+        self.last_publish_bucket_bytes = per_bucket
+        return chunk_counts, {"buckets": bucket_counts(bks)}
 
     def _gc(self, version: int) -> None:
         if version < 0:
@@ -162,15 +237,59 @@ class KVPytreeChannel:
         if meta_s is None:
             return None
         meta = json.loads(meta_s)
+        counts = meta["chunks"]
+        bucket_leaf_counts = meta.get("buckets")
+        if (self.workers > 1 and bucket_leaf_counts is not None
+                and len(bucket_leaf_counts) > 1):
+            leaves = self._fetch_bucketed(version, counts, bucket_leaf_counts)
+        else:
+            leaves = self._fetch_serial(version, counts)
+        if leaves is None:
+            return None
+        return version, jax.tree.unflatten(self.treedef, leaves), meta
+
+    def _fetch_serial(self, version: int, counts: List[int]):
         leaves = []
-        for l_idx, n in enumerate(meta["chunks"]):
+        for l_idx, n in enumerate(counts):
             chunks = [self.kv.get(f"{self.prefix}/{version}/{l_idx}/{c_idx}")
                       for c_idx in range(n)]
             if any(c is None for c in chunks):
                 return None  # concurrently GC'd (reader was very stale)
             self.bytes_in += sum(len(c) for c in chunks)
             leaves.append(_decode_leaf(chunks))
-        return version, jax.tree.unflatten(self.treedef, leaves), meta
+        return leaves
+
+    def _fetch_bucketed(self, version: int, counts: List[int],
+                        bucket_leaf_counts: List[int]):
+        """Concurrent per-bucket get+decode along the writer's bucket plan
+        (shipped in meta): bucket k decodes while bucket k+1's chunks are
+        still in flight. Any missing chunk (concurrent GC) voids the read,
+        matching the serial contract."""
+        pool = self._executor()
+
+        def get_decode(b_idx: int, start: int, n_leaves: int):
+            with _span("wire_decode", channel=self.prefix, bucket=b_idx,
+                       leaves=n_leaves):
+                leaves, nbytes = [], 0
+                for l_idx in range(start, start + n_leaves):
+                    chunks = [
+                        self.kv.get(f"{self.prefix}/{version}/{l_idx}/{c_idx}")
+                        for c_idx in range(counts[l_idx])]
+                    if any(c is None for c in chunks):
+                        return None
+                    nbytes += sum(len(c) for c in chunks)
+                    leaves.append(_decode_leaf(chunks))
+                return leaves, nbytes
+
+        futures, start = [], 0
+        for b_idx, n_leaves in enumerate(bucket_leaf_counts):
+            futures.append(pool.submit(get_decode, b_idx, start, n_leaves))
+            start += n_leaves
+        results = [f.result() for f in futures]
+        if any(r is None for r in results):
+            return None
+        self.bytes_in += sum(nb for _, nb in results)
+        return [l for block, _ in results for l in block]
 
 
 class KVGradientTransport:
@@ -179,13 +298,18 @@ class KVGradientTransport:
 
     def __init__(self, kv, n_slices: int, grad_template: Any,
                  param_template: Any, run_id: str = "run", level: int = 3,
-                 codec: str = "blosc"):
-        self.n_slices = n_slices
+                 codec: str = "blosc", bucket_bytes: int = 0,
+                 workers: int = 0):
         self.grad_ch = [KVPytreeChannel(kv, f"{run_id}/agrad/{s}",
-                                        grad_template, level, codec)
+                                        grad_template, level, codec,
+                                        bucket_bytes=bucket_bytes,
+                                        workers=workers)
                         for s in range(n_slices)]
         self.param_ch = KVPytreeChannel(kv, f"{run_id}/aparams",
-                                        param_template, level, codec)
+                                        param_template, level, codec,
+                                        bucket_bytes=bucket_bytes,
+                                        workers=workers)
+        self.n_slices = n_slices
         self._last_seen = [0] * n_slices
         self.kv = kv
         self.run_id = run_id

@@ -113,10 +113,17 @@ class AsyncTrainer:
         # BN stats the checkpointing worker had).
         self._bs0 = lambda: jax.tree.map(lambda a: a[0], self._bs)
         param_template = {"params": self.params, "bs0": self._bs0()}
+        # Overlapped wire (--wire-bucket-mb/--wire-workers): the channels
+        # sync+encode+put bucket k while bucket k+1 is still on device, so
+        # publish cost hides under the tail of backward instead of landing
+        # after it. 0 restores the blocking single-payload schedule.
+        wire_bucket_bytes = int(cfg.wire_bucket_mb * (1 << 20))
+        self._wire_overlap = wire_bucket_bytes > 0 and not self._wire_int8
         self.transport = KVGradientTransport(
             kv, self.n, grad_template=grad_template,
             param_template=param_template, run_id=f"async-{cfg.seed}",
-            level=cfg.codec_level, codec=chan_codec)
+            level=cfg.codec_level, codec=chan_codec,
+            bucket_bytes=wire_bucket_bytes, workers=cfg.wire_workers)
 
         # Per-slice data: this process is shard pid-of-n over the shared-seed
         # shuffle; each slice draws cfg.batch_size per step like a reference
@@ -203,7 +210,11 @@ class AsyncTrainer:
     # ---- wire codecs ----
     def _encode_grads(self, grads):
         if not self._wire_int8:
-            return jax.device_get(grads)
+            # Overlapped wire: hand the DEVICE arrays to the channel — it
+            # blocks per BUCKET (flat-leaf order) and encodes bucket k while
+            # bucket k+1 is still computing. The blocking wire keeps the one
+            # batched device_get (whole tree on host before any encode).
+            return grads if self._wire_overlap else jax.device_get(grads)
         from ps_pytorch_tpu.ops.quantize import quantize_int8
         key = jax.random.key(self.cfg.seed * 31 + self._seq * self.n + self.pid)
         leaves, treedef = jax.tree.flatten(grads)
@@ -236,9 +247,10 @@ class AsyncTrainer:
     # ---- the two roles ----
     def _publish_canonical(self) -> None:
         t0 = time.monotonic()
-        self.transport.publish_params(
-            self.version, {"params": jax.device_get(self.params),
-                           "bs0": jax.device_get(self._bs0())})
+        payload = {"params": self.params, "bs0": self._bs0()}
+        if not self._wire_overlap:
+            payload = jax.device_get(payload)
+        self.transport.publish_params(self.version, payload)
         self.last_publish_s = time.monotonic() - t0
 
     def _compute_and_submit(self, version_used: int) -> dict:

@@ -117,6 +117,14 @@ class Coordinator:
         # a dead host stops reporting).
         self.liveness = liveness
         self.stats: Dict[str, int] = {"mask_changes": 0}
+        # Follower mask-wait backoff (resilience/retry.py): starts at the
+        # old 2 ms poll, backs off exponentially to 100 ms, jittered so N
+        # followers don't hammer the service in lockstep. Seeded by replica
+        # count for determinism; each Coordinator keeps its own rng stream.
+        from ps_pytorch_tpu.resilience.retry import RetryPolicy
+        self._mask_backoff = RetryPolicy(base_s=0.002, max_s=0.1,
+                                         jitter=0.5, seed=n_replicas)
+        self._mask_rng = self._mask_backoff.delays()
         self._last_printed_mask: Optional[str] = None
         # last observed per-replica step duration (telemetry; seconds)
         self._last_duration = np.zeros(n_replicas, np.float64)
@@ -181,33 +189,60 @@ class Coordinator:
         # inflicts on everyone else — and on the leader the decide+publish.
         with _span("coordinator_mask", step=step):
             if not self.leader:
-                deadline = time.monotonic() + timeout_s
-                while True:
-                    v = self.kv.get(key)
-                    if v is not None:
-                        return np.asarray(json.loads(v), np.float32)
-                    if time.monotonic() > deadline:
-                        raise TimeoutError(f"no mask published for step {step}")
-                    time.sleep(0.002)
-            mask = self._decide_mask()
-            # Observability: one stable line whenever the decision changes (the
-            # reference's only straggler evidence was per-worker timing logs).
-            desc = json.dumps(mask.astype(int).tolist())
-            if desc != self._last_printed_mask:
-                print(f"MASK step {step} {desc}")
-                if self._last_printed_mask is not None:
-                    self.stats["mask_changes"] += 1
-                self._last_printed_mask = desc
-            self.kv.set(key, json.dumps(mask.tolist()))
-            # GC with a WIDE window, not step-2: JAX dispatch is async and
-            # followers only synchronize when metrics materialize (log_every), so
-            # a follower can lag many host-loop iterations behind the leader —
-            # deleting a mask it has not yet read would strand it in a 300 s
-            # TimeoutError (round-1 advisor, medium). Masks are ~n_replicas
-            # floats, so retaining `mask_gc_window` of them is still O(1).
-            if step >= self.mask_gc_window:
-                self.kv.delete(f"{self.run_id}/mask/{step - self.mask_gc_window}")
-            return mask
+                return self._await_mask(key, step, timeout_s)
+            return self._decide_and_publish_mask(key, step)
+
+    def _await_mask(self, key: str, step: int, timeout_s: float) -> np.ndarray:
+        """Follower-side mask wait: jittered exponential backoff (the
+        resilience/retry.py policy, de-synchronized across followers by the
+        replica-count seed) instead of the old fixed 2 ms hammer, and
+        TRANSIENT KV errors are absorbed as "not published yet" rather than
+        killing the follower mid-wait. The deadline is still authoritative:
+        a leader that never publishes remains a TimeoutError."""
+        deadline = time.monotonic() + timeout_s
+        attempt = 0
+        while True:
+            try:
+                v = self.kv.get(key)
+            except Exception as e:
+                from ps_pytorch_tpu.resilience.retry import is_retryable
+                if not is_retryable(e):
+                    raise
+                self.stats["mask_wait_errors"] = \
+                    self.stats.get("mask_wait_errors", 0) + 1
+                v = None
+            if v is not None:
+                return np.asarray(json.loads(v), np.float32)
+            now = time.monotonic()
+            if now > deadline:
+                raise TimeoutError(f"no mask published for step {step}")
+            delay = self._mask_backoff.delay(attempt, self._mask_rng)
+            time.sleep(min(delay, max(deadline - now, 0.0)))
+            # Cap the exponent: the wait is open-ended (attempt count is not
+            # bounded by a max_attempts), so let the delay saturate at max_s
+            # instead of overflowing multiplier**attempt.
+            attempt = min(attempt + 1, 30)
+
+    def _decide_and_publish_mask(self, key: str, step: int) -> np.ndarray:
+        mask = self._decide_mask()
+        # Observability: one stable line whenever the decision changes (the
+        # reference's only straggler evidence was per-worker timing logs).
+        desc = json.dumps(mask.astype(int).tolist())
+        if desc != self._last_printed_mask:
+            print(f"MASK step {step} {desc}")
+            if self._last_printed_mask is not None:
+                self.stats["mask_changes"] += 1
+            self._last_printed_mask = desc
+        self.kv.set(key, json.dumps(mask.tolist()))
+        # GC with a WIDE window, not step-2: JAX dispatch is async and
+        # followers only synchronize when metrics materialize (log_every), so
+        # a follower can lag many host-loop iterations behind the leader —
+        # deleting a mask it has not yet read would strand it in a 300 s
+        # TimeoutError (round-1 advisor, medium). Masks are ~n_replicas
+        # floats, so retaining `mask_gc_window` of them is still O(1).
+        if step >= self.mask_gc_window:
+            self.kv.delete(f"{self.run_id}/mask/{step - self.mask_gc_window}")
+        return mask
 
     def _decide_mask(self) -> np.ndarray:
         # Kills are a KV protocol (tag-77 equivalent): pull every replica's

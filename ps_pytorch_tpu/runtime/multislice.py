@@ -114,7 +114,9 @@ class MultiSliceTrainer:
             n_slices, staleness_limit=cfg.staleness_limit,
             staleness_decay=cfg.staleness_decay,
             num_aggregate=cfg.num_aggregate, compress=cfg.compress_grad,
-            codec=cfg.grad_codec, codec_level=cfg.codec_level)
+            codec=cfg.grad_codec, codec_level=cfg.codec_level,
+            wire_bucket_bytes=int(cfg.wire_bucket_mb * (1 << 20)),
+            wire_workers=cfg.wire_workers)
         from ps_pytorch_tpu.data.augment import input_norm_for
         self._input_norm = input_norm_for(cfg)
         self.grad_fns = [make_slice_grad_fn(self.model, m, self.has_bn,
@@ -237,13 +239,18 @@ class MultiSliceTrainer:
 
     def maybe_resume(self) -> bool:
         """Restore canonical params/opt state (and slice-0 BN stats; other
-        slices keep fresh stats, like freshly relaunched reference workers)."""
+        slices keep fresh stats, like freshly relaunched reference workers).
+        Manifest-verified: a corrupt newest checkpoint (torn write mid-
+        preemption) is skipped in favor of the latest VALID one, same as the
+        sync Trainer and the async per-replica path."""
         from ps_pytorch_tpu.runtime import checkpoint as ckpt
-        step = ckpt.latest_step(self.cfg.train_dir)
-        if step is None:
+        if ckpt.latest_step(self.cfg.train_dir) is None:
             return False
-        state, meta, _ = ckpt.load_checkpoint(
-            self.cfg.train_dir, step, jax.device_get(self._as_train_state()))
+        got = ckpt.load_latest_valid(
+            self.cfg.train_dir, jax.device_get(self._as_train_state()))
+        if got is None:
+            return False
+        state, meta, _, step = got
         self.params = jax.device_put(state.params)
         self.opt_state = jax.device_put(state.opt_state)
         self._bs[0] = jax.device_put(state.batch_stats)

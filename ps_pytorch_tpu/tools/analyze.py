@@ -33,6 +33,15 @@ the run total) plus the steps covered and final mask changes:
 
     python -m ps_pytorch_tpu.tools.analyze faults /tmp/m.jsonl
     python -m ps_pytorch_tpu.tools.analyze faults chaos.jsonl --json
+
+Wire mode reads a span timeline (the Tracer's span-dict JSONL or an
+exported Chrome trace) and breaks the overlapped gradient wire down:
+per-stage totals (wire_publish/encode/put/read/decode), per-bucket
+encode/put/decode seconds + bytes, and the publish/read overlap fractions
+(1 - wall/serial; see wire_summary):
+
+    python -m ps_pytorch_tpu.tools.analyze wire /tmp/wire_spans.jsonl
+    python -m ps_pytorch_tpu.tools.analyze wire trace.json --json
 """
 
 import argparse
@@ -196,6 +205,132 @@ def timeline_main(args, parser) -> int:
     return 0
 
 
+# ---- wire mode (overlapped-wire span breakdown) ----
+
+def read_span_events(path: str) -> List[dict]:
+    """Span-timeline file -> [{"name", "t0", "dur", "args"}] (seconds).
+
+    Accepts either the Tracer's span-dict JSONL (telemetry/trace.py
+    ``spans()``, one dict per line with t0/dur in seconds) or an exported
+    Chrome trace JSON (``write_chrome_trace``, 'X' events with ts/dur in
+    microseconds)."""
+    with open(path) as f:
+        text = f.read().strip()
+    events: List[dict] = []
+    doc = None
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        pass
+    if isinstance(doc, dict) and "traceEvents" in doc:
+        for e in doc["traceEvents"]:
+            if e.get("ph") == "X":
+                events.append({"name": e["name"], "t0": e["ts"] / 1e6,
+                               "dur": e["dur"] / 1e6,
+                               "args": e.get("args", {})})
+        return events
+    for line in text.splitlines():
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(rec, dict) and "name" in rec and "dur" in rec:
+            events.append({"name": rec["name"], "t0": float(rec.get("t0", 0)),
+                           "dur": float(rec["dur"]),
+                           "args": rec.get("args", {})})
+    return events
+
+
+def wire_summary(events: List[dict]) -> dict:
+    """wire_* spans -> per-stage totals, per-bucket breakdown, and overlap
+    fractions.
+
+    overlap fraction = 1 - wall / serial, where serial is the summed time
+    of the pipelined sub-stages (encode+put under a wire_publish; decode
+    under a wire_read) and wall is the enclosing span's duration: 0 means
+    the schedule ran fully serial, ->1 means the sub-stage work was almost
+    entirely hidden by pipelining. The blocking wire has no sub-spans, so
+    its fractions read as null."""
+    stages: Dict[str, dict] = {}
+    per_bucket: Dict[int, dict] = {}
+    for e in events:
+        name = e["name"]
+        if not name.startswith("wire_"):
+            continue
+        st = stages.setdefault(name, {"count": 0, "total_s": 0.0, "bytes": 0})
+        st["count"] += 1
+        st["total_s"] += e["dur"]
+        args = e.get("args") or {}
+        if "bytes" in args:
+            st["bytes"] += int(args["bytes"])
+        if "bucket" in args and name in ("wire_encode", "wire_put",
+                                         "wire_decode"):
+            b = per_bucket.setdefault(int(args["bucket"]),
+                                      {"bucket": int(args["bucket"]),
+                                       "encode_s": 0.0, "put_s": 0.0,
+                                       "decode_s": 0.0, "bytes": 0})
+            b[name[len("wire_"):] + "_s"] += e["dur"]
+            if "bytes" in args:
+                b["bytes"] += int(args["bytes"])
+    for st in stages.values():
+        st["total_s"] = round(st["total_s"], 6)
+
+    def frac(wall: float, serial: float):
+        if wall <= 0 or serial <= 0:
+            return None
+        return round(max(0.0, 1.0 - wall / serial), 4)
+
+    pub_wall = stages.get("wire_publish", {}).get("total_s", 0.0)
+    pub_serial = (stages.get("wire_encode", {}).get("total_s", 0.0)
+                  + stages.get("wire_put", {}).get("total_s", 0.0))
+    read_wall = stages.get("wire_read", {}).get("total_s", 0.0)
+    read_serial = stages.get("wire_decode", {}).get("total_s", 0.0)
+    return {"stages": {k: stages[k] for k in sorted(stages)},
+            "buckets": [dict(per_bucket[k],
+                             encode_s=round(per_bucket[k]["encode_s"], 6),
+                             put_s=round(per_bucket[k]["put_s"], 6),
+                             decode_s=round(per_bucket[k]["decode_s"], 6))
+                        for k in sorted(per_bucket)],
+            "publish_overlap_fraction": frac(pub_wall, pub_serial),
+            "read_overlap_fraction": frac(read_wall, read_serial)}
+
+
+def wire_markdown(summary: dict) -> str:
+    lines = ["| stage | count | total | bytes |", "|---|---|---|---|"]
+    for name, st in summary["stages"].items():
+        lines.append(f"| {name} | {st['count']} | {st['total_s']:.6f} s "
+                     f"| {st['bytes']} |")
+    if summary["buckets"]:
+        lines += ["", "| bucket | encode | put | decode | bytes |",
+                  "|---|---|---|---|---|"]
+        for b in summary["buckets"]:
+            lines.append(f"| {b['bucket']} | {b['encode_s']:.6f} s "
+                         f"| {b['put_s']:.6f} s | {b['decode_s']:.6f} s "
+                         f"| {b['bytes']} |")
+    for side in ("publish", "read"):
+        v = summary[f"{side}_overlap_fraction"]
+        lines.append(f"\n{side} overlap fraction: "
+                     + ("n/a (no pipelined sub-spans)" if v is None
+                        else f"{v:.4f}"))
+    return "\n".join(lines)
+
+
+def wire_main(args, parser) -> int:
+    files: List[str] = []
+    for pattern in args.runs:
+        files.extend(sorted(glob.glob(pattern)) or
+                     parser.error(f"no files match {pattern!r}") or [])
+    events = [e for path in files for e in read_span_events(path)]
+    if not any(e["name"].startswith("wire_") for e in events):
+        parser.error(f"no wire_* spans in {files}")
+    summary = wire_summary(events)
+    if args.json:
+        print(json.dumps(summary))
+    else:
+        print(wire_markdown(summary))
+    return 0
+
+
 # ---- faults mode (resilience counter summary) ----
 
 def fault_summary(rows: List[dict]) -> dict:
@@ -269,6 +404,9 @@ def main(argv=None) -> int:
     if args.runs[0] == "faults":
         args.runs = args.runs[1:] or p.error("faults mode needs FILE...")
         return faults_main(args, p)
+    if args.runs[0] == "wire":
+        args.runs = args.runs[1:] or p.error("wire mode needs FILE...")
+        return wire_main(args, p)
 
     runs: Dict[str, List[str]] = {}
     for spec in args.runs:

@@ -240,6 +240,31 @@ def collect(repo: str):
             "crashes": c.get("crashes"),
             "kv_retries": c.get("kv_retries"),
             "ok": d.get("ok") is True and "_parse_error" not in d})
+    p = _newest("BENCH_WIRE_r[0-9]*.json", repo)
+    if p:
+        # Wire-overlap evidence (bench_suite wire_blocking_*/wire_overlapped_*
+        # pairs + derived wire_overlap_win_* rows): ok means every pair was
+        # bitwise-identical to the blocking wire AND cleared its speedup bar.
+        rows = _load(p)
+        if isinstance(rows, dict):
+            rows = [rows]
+        rows = [r for r in rows if isinstance(r, dict)]
+        errors = [r.get("config", r.get("_parse_error", "?")) for r in rows
+                  if "error" in r or "_parse_error" in r]
+        wins = [r for r in rows
+                if str(r.get("config", "")).startswith("wire_overlap_win")]
+        head = max(wins, key=lambda r: r.get("ratio") or 0.0, default=None)
+        add("wire overlap", p, {
+            "rows": len(rows),
+            "value": head.get("ratio") if head else None,
+            "unit": "x vs blocking ({})".format(
+                head.get("config", "?") if head else "?"),
+            "platform": next((r.get("platform") for r in rows
+                              if r.get("platform")), "host"),
+            "ok": bool(wins) and not errors
+            and all(r.get("ok") is True and r.get("bitwise_identical") is True
+                    for r in wins),
+            "errors": errors})
     p = os.path.join(repo, "COPYCHECK.json")
     if os.path.exists(p):
         d = as_dict(_load(p))

@@ -47,4 +47,30 @@ def test_all_configs_registered():
     assert set(CONFIGS) >= {
         "lenet_mnist_single", "lenet_mnist_dp", "resnet18_cifar10_dp",
         "vgg11_cifar100_kofn", "resnet50_imagenet", "lenet_convergence",
-        "moe_lm_2k", "transformer_lm_2k"}
+        "moe_lm_2k", "transformer_lm_2k",
+        "wire_blocking_8mb", "wire_overlapped_8mb",
+        "wire_blocking_64mb", "wire_overlapped_64mb"}
+
+
+def test_wire_bench_pair_bitwise_identical(tmp_path):
+    """Tiny blocking/overlapped wire pair: same payload hash (bucketing is a
+    schedule, not a format), sane row fields, and the trace dump feeds the
+    analyze wire mode."""
+    from bench_suite import bench_wire
+
+    blocking = bench_wire("wb", 1, payload_mb=2, leaf_kb=256, bucket_mb=0,
+                          workers=0, rtt_ms=0.2)
+    trace = tmp_path / "wire_spans.jsonl"
+    overlapped = bench_wire("wo", 1, payload_mb=2, leaf_kb=256, bucket_mb=1,
+                            workers=2, rtt_ms=0.2, trace_out=str(trace))
+    assert blocking["payload_sha256"] == overlapped["payload_sha256"]
+    assert blocking["buckets"] == 1 and overlapped["buckets"] == 2
+    assert blocking["wire_mb"] == overlapped["wire_mb"] > 0
+    assert overlapped["publish_s"] > 0 and overlapped["read_s"] > 0
+
+    from ps_pytorch_tpu.tools.analyze import read_span_events, wire_summary
+    s = wire_summary(read_span_events(str(trace)))
+    assert s["stages"]["wire_encode"]["count"] == 2     # one per bucket
+    assert s["stages"]["wire_decode"]["count"] == 2
+    assert len(s["buckets"]) == 2
+    assert s["publish_overlap_fraction"] is not None

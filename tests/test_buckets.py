@@ -1,0 +1,109 @@
+"""Bucketing + streaming schedule (parallel/buckets.py) and the vectorized
+base85 armour (utils/armor.py) that the overlapped wire rides on.
+"""
+
+import base64
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from ps_pytorch_tpu.parallel.buckets import (
+    Bucket, bucket_counts, leaf_nbytes, plan_buckets, stream_buckets,
+)
+from ps_pytorch_tpu.utils.armor import b85decode, b85encode
+
+
+def _leaves(sizes_kb):
+    return [np.zeros(kb * 256, np.float32) for kb in sizes_kb]  # kb KiB each
+
+
+def test_plan_buckets_contiguous_and_deterministic():
+    leaves = _leaves([1, 1, 1, 2, 4, 1])
+    bks = plan_buckets(leaves, 3 * 1024)
+    # Full, ordered, non-overlapping cover of the leaf sequence.
+    assert bks[0].start == 0 and bks[-1].stop == len(leaves)
+    for a, b in zip(bks, bks[1:]):
+        assert a.stop == b.start
+    assert [b.index for b in bks] == list(range(len(bks)))
+    # Greedy close: [1+1+1], [2], [4], [1] KiB — 2 closes because 2+4 > 3.
+    assert bucket_counts(bks) == [3, 1, 1, 1]
+    assert bks[0].nbytes == 3 * 1024
+    # Same input -> same plan (dataclass equality).
+    assert plan_buckets(leaves, 3 * 1024) == bks
+
+
+def test_plan_buckets_oversized_leaf_gets_own_bucket():
+    bks = plan_buckets(_leaves([1, 16, 1]), 4 * 1024)
+    assert bucket_counts(bks) == [1, 1, 1]
+    assert bks[1].nbytes == 16 * 1024
+
+
+def test_plan_buckets_edge_cases():
+    assert plan_buckets([], 1024) == []
+    # bucket_bytes <= 0: one bucket spanning everything (blocking schedule).
+    leaves = _leaves([1, 2, 3])
+    assert plan_buckets(leaves, 0) == [Bucket(0, 0, 3, 6 * 1024)]
+    # 0-d and empty leaves bucket fine.
+    odd = [np.float32(3.0), np.zeros((0, 4), np.float32)]
+    assert leaf_nbytes(odd[0]) == 4 and leaf_nbytes(odd[1]) == 0
+    assert bucket_counts(plan_buckets(odd, 2)) == [1, 1]
+
+
+def test_stream_buckets_serial_vs_pooled_same_results():
+    leaves = _leaves([1, 1, 1, 1, 1, 1])
+    bks = plan_buckets(leaves, 2 * 1024)
+    assert len(bks) == 3
+
+    def fn(b, block):
+        return (b.index, sum(l.nbytes for l in block))
+
+    serial = stream_buckets(leaves, bks, fn)
+    with ThreadPoolExecutor(max_workers=2) as pool:
+        pooled = stream_buckets(leaves, bks, fn, pool)
+    assert serial == pooled == [(0, 2048), (1, 2048), (2, 2048)]
+
+
+def test_stream_buckets_pooled_runs_on_workers_and_reraises():
+    leaves = _leaves([1, 1, 1, 1])
+    bks = plan_buckets(leaves, 1024)
+    tids = []
+    with ThreadPoolExecutor(max_workers=2) as pool:
+        stream_buckets(leaves, bks,
+                       lambda b, block: tids.append(threading.get_ident()),
+                       pool)
+        assert threading.get_ident() not in tids
+
+        def boom(b, block):
+            if b.index == 2:
+                raise RuntimeError("bucket 2 failed")
+            return b.index
+
+        with pytest.raises(RuntimeError, match="bucket 2"):
+            stream_buckets(leaves, bks, boom, pool)
+
+
+@pytest.mark.parametrize("n", [0, 1, 4, 511, 512, 513, 1023, 4096, 65537])
+def test_armor_matches_stdlib_bitwise(n):
+    rng = np.random.default_rng(n)
+    data = rng.integers(0, 256, n, dtype=np.uint8).tobytes()
+    enc = b85encode(data)
+    assert enc == base64.b85encode(data)
+    assert b85decode(enc) == data
+    assert base64.b85decode(enc) == data
+    # str input accepted like the call sites use it.
+    assert b85decode(enc.decode("ascii")) == data
+
+
+def test_armor_bad_input_raises_like_stdlib():
+    text = b85encode(bytes(range(256)) * 4)
+    bad = b"\x01" + text[1:]
+    with pytest.raises(ValueError):
+        b85decode(bad)
+    try:
+        base64.b85decode(bad)
+    except ValueError as e:
+        expected = str(e)
+    with pytest.raises(ValueError, match=expected.split(":")[0]):
+        b85decode(bad)

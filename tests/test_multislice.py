@@ -138,3 +138,31 @@ def test_async_checkpoint_and_resume(tmp_path):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
     t2.train()
     assert t2.step == 9
+
+
+def test_async_resume_falls_back_past_corrupt_checkpoint(tmp_path):
+    """Manifest verification on the async resume path: a bit-flipped replica
+    payload in the NEWEST checkpoint must not be silently restored — resume
+    walks back to the older checkpoint that still verifies."""
+    import os
+    from ps_pytorch_tpu.resilience.faults import corrupt_file
+    from ps_pytorch_tpu.runtime import checkpoint as ckpt
+    from ps_pytorch_tpu.runtime.multislice import MultiSliceTrainer
+
+    cfg = _cfg(max_steps=6, eval_freq=3, train_dir=str(tmp_path), resume=True)
+    MultiSliceTrainer(cfg, n_slices=2).train()
+    assert (tmp_path / "model_step_3").is_dir()
+    assert (tmp_path / "model_step_6").is_dir()
+    # Corrupt the newest checkpoint's largest payload file (a replica
+    # array blob, not the manifest).
+    newest = tmp_path / "model_step_6"
+    victim = max((p for p in newest.iterdir()
+                  if "manifest" not in p.name),
+                 key=lambda p: p.stat().st_size)
+    assert corrupt_file(str(victim))
+    assert not ckpt.verify_checkpoint(str(tmp_path), 6)
+    assert ckpt.verify_checkpoint(str(tmp_path), 3)
+
+    t = MultiSliceTrainer(cfg.replace(max_steps=9), n_slices=2)
+    assert t.maybe_resume()
+    assert t.step == 3

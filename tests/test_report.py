@@ -66,6 +66,33 @@ def test_malformed_artifacts_flag_not_crash(tmp_path):
     assert entries["copycheck"]["ok"] is False
 
 
+def test_wire_overlap_family(tmp_path):
+    """BENCH_WIRE artifacts: value is the best win ratio; ok requires every
+    win row to clear its bar AND be bitwise-identical to the blocking wire."""
+    _write(tmp_path, "BENCH_WIRE_r08.json", "\n".join(json.dumps(r) for r in [
+        {"config": "wire_blocking_64mb", "platform": "host",
+         "total_s": 4.0, "payload_sha256": "aa"},
+        {"config": "wire_overlapped_64mb", "platform": "host",
+         "total_s": 2.5, "payload_sha256": "aa"},
+        {"config": "wire_overlap_win_8mb", "ratio": 1.31,
+         "bitwise_identical": True, "ok": True},
+        {"config": "wire_overlap_win_64mb", "ratio": 1.6,
+         "bitwise_identical": True, "ok": True},
+    ]))
+    entries = {e["family"]: e for e in report.collect(str(tmp_path))}
+    e = entries["wire overlap"]
+    assert e["artifact"] == "BENCH_WIRE_r08.json"
+    assert e["value"] == 1.6 and "64mb" in e["unit"]
+    assert e["ok"] is True
+    # A newer round with a pair that missed the speedup bar flips ok.
+    _write(tmp_path, "BENCH_WIRE_r09.json", json.dumps(
+        {"config": "wire_overlap_win_64mb", "ratio": 1.1,
+         "bitwise_identical": True, "ok": False}))
+    entries = {e["family"]: e for e in report.collect(str(tmp_path))}
+    assert entries["wire overlap"]["artifact"] == "BENCH_WIRE_r09.json"
+    assert entries["wire overlap"]["ok"] is False
+
+
 def test_cli_table_runs(tmp_path, capsys):
     _write(tmp_path, "ACCURACY_r05.json",
            {"prec1": 0.98, "platform": "tpu", "met_target": True})

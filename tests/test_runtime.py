@@ -121,6 +121,54 @@ def test_coordinator_mask_gc_window():
         follower.participation_mask(9, timeout_s=0.1)
 
 
+def test_coordinator_mask_wait_retries_transient_kv():
+    """Follower mask-wait must survive a flaky coordination service: a
+    retryable KV error mid-wait is absorbed (counted, backed off, retried),
+    not raised — only the deadline or a FATAL error ends the wait."""
+    leader = Coordinator(2, mode="sync")
+    leader.participation_mask(1)
+
+    class FlakyKV:
+        def __init__(self, inner, failures):
+            self.inner, self.failures = inner, failures
+
+        def get(self, key, default=None):
+            if "/mask/" in key and self.failures > 0:
+                self.failures -= 1
+                raise ConnectionError("coordination service hiccup")
+            return self.inner.get(key, default)
+
+        def set(self, key, value):
+            self.inner.set(key, value)
+
+        def delete(self, key):
+            self.inner.delete(key)
+
+    follower = Coordinator(2, mode="sync", kv=FlakyKV(leader.kv, 3),
+                           leader=False)
+    np.testing.assert_array_equal(
+        follower.participation_mask(1, timeout_s=5.0), [1, 1])
+    assert follower.stats["mask_wait_errors"] == 3
+    # Unpublished mask still times out promptly (deadline is authoritative
+    # even while backing off).
+    import time
+    t0 = time.monotonic()
+    with pytest.raises(TimeoutError):
+        follower.participation_mask(99, timeout_s=0.2)
+    assert time.monotonic() - t0 < 2.0
+
+    class FatalKV(FlakyKV):
+        def get(self, key, default=None):
+            if "/mask/" in key:
+                raise ValueError("corrupt key")  # non-retryable
+            return self.inner.get(key, default)
+
+    broken = Coordinator(2, mode="sync", kv=FatalKV(leader.kv, 0),
+                         leader=False)
+    with pytest.raises(ValueError, match="corrupt"):
+        broken.participation_mask(1, timeout_s=1.0)
+
+
 def test_coordinator_validates():
     with pytest.raises(ValueError):
         Coordinator(4, mode="kofn", num_aggregate=0)

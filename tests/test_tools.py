@@ -57,6 +57,61 @@ def test_analyze_parses_human_lines(tmp_path):
     assert s["steps"] == 2 and s["normal"] == pytest.approx(0.5)
 
 
+def test_analyze_wire_summary_and_cli(tmp_path, capsys):
+    """wire mode: per-stage totals, per-bucket breakdown, overlap fractions
+    (1 - wall/serial), from both Tracer span JSONL and Chrome trace input."""
+    from ps_pytorch_tpu.tools import analyze
+
+    spans = [
+        {"name": "wire_publish", "t0": 0.0, "dur": 0.5, "tid": 1},
+        {"name": "wire_encode", "t0": 0.0, "dur": 0.3, "tid": 2,
+         "args": {"bucket": 0, "leaves": 2}},
+        {"name": "wire_put", "t0": 0.3, "dur": 0.3, "tid": 2,
+         "args": {"bucket": 0, "bytes": 1000}},
+        {"name": "wire_encode", "t0": 0.1, "dur": 0.2, "tid": 3,
+         "args": {"bucket": 1, "leaves": 1}},
+        {"name": "wire_put", "t0": 0.3, "dur": 0.2, "tid": 3,
+         "args": {"bucket": 1, "bytes": 500}},
+        {"name": "wire_read", "t0": 1.0, "dur": 0.4, "tid": 1},
+        {"name": "wire_decode", "t0": 1.0, "dur": 0.3, "tid": 2,
+         "args": {"bucket": 0, "leaves": 2}},
+        {"name": "wire_decode", "t0": 1.0, "dur": 0.3, "tid": 3,
+         "args": {"bucket": 1, "leaves": 1}},
+        {"name": "step", "t0": 0.0, "dur": 2.0, "tid": 1},  # non-wire: ignored
+    ]
+    p = tmp_path / "spans.jsonl"
+    p.write_text("\n".join(json.dumps(s) for s in spans))
+    summary = analyze.wire_summary(analyze.read_span_events(str(p)))
+    # publish wall 0.5 s vs encode+put serial 1.0 s -> half the work hidden.
+    assert summary["publish_overlap_fraction"] == pytest.approx(0.5)
+    # read wall 0.4 s vs decode serial 0.6 s.
+    assert summary["read_overlap_fraction"] == pytest.approx(0.3333)
+    assert [b["bucket"] for b in summary["buckets"]] == [0, 1]
+    assert summary["buckets"][0]["bytes"] == 1000
+    assert summary["stages"]["wire_put"]["bytes"] == 1500
+    assert "step" not in summary["stages"]
+    # Chrome-trace input (ts/dur in µs) parses to the same events.
+    chrome = tmp_path / "trace.json"
+    chrome.write_text(json.dumps({"traceEvents": [
+        {"ph": "X", "name": s["name"], "ts": s["t0"] * 1e6,
+         "dur": s["dur"] * 1e6, "pid": 0, "tid": s["tid"],
+         "args": s.get("args", {})} for s in spans]}))
+    assert (analyze.wire_summary(analyze.read_span_events(str(chrome)))
+            == summary)
+    # Blocking wire (no sub-spans) -> fractions read n/a, not 0 or a crash.
+    blk = tmp_path / "blocking.jsonl"
+    blk.write_text(json.dumps({"name": "wire_publish", "t0": 0.0,
+                               "dur": 0.5, "tid": 1}))
+    assert (analyze.wire_summary(analyze.read_span_events(str(blk)))
+            ["publish_overlap_fraction"] is None)
+
+    from ps_pytorch_tpu.tools.analyze import main as analyze_main
+    assert analyze_main(["wire", str(p)]) == 0
+    out = capsys.readouterr().out
+    assert "publish overlap fraction: 0.5000" in out
+    assert "| wire_put | 2 |" in out
+
+
 # ------------------------------------------------------------------ sweep --
 
 TRAIN_ARGS = ["--network", "LeNet", "--dataset", "synthetic_mnist",

@@ -134,6 +134,105 @@ def test_wire_stats_count_armoured_bytes():
     assert st["last_param_publish_bytes"] < raw * 1.4 + 4096
 
 
+def test_chunk_boundary_exact_multiple(monkeypatch):
+    """b85 text whose length is an EXACT _CHUNK multiple: every chunk full,
+    no phantom empty trailing chunk, round-trip and byte accounting exact."""
+    from ps_pytorch_tpu.parallel import transport
+    # raw framing has nbytes % 4 == 0 (magic+npy header+float32 data), so
+    # the armoured text length is a multiple of 5; _CHUNK=5 puts every
+    # chunk boundary exactly at the end of a full chunk.
+    monkeypatch.setattr(transport, "_CHUNK", 5)
+    t = {"w": np.arange(600, dtype=np.float32)}
+    kv = KVStore()
+    ch = KVPytreeChannel(kv, "t/ch", t, codec="raw")
+    ch.publish(1, t)
+    import json
+    n = json.loads(kv.get("t/ch/1/meta"))["chunks"][0]
+    assert all(len(kv.get(f"t/ch/1/0/{c}")) == 5 for c in range(n))
+    assert kv.get(f"t/ch/1/0/{n}") is None  # no empty chunk past the end
+    _, got, _ = ch.read()
+    np.testing.assert_array_equal(got["w"], t["w"])
+    assert ch.bytes_out == ch.bytes_in == n * 5
+
+
+@pytest.mark.parametrize("codec", ["raw", "blosc"])
+def test_zero_d_and_empty_leaf_roundtrip(codec):
+    t = {"s": np.float32(3.5), "e": np.zeros((0, 4), np.float32),
+         "w": np.ones((3,), np.float32)}
+    kv = KVStore()
+    ch = KVPytreeChannel(kv, "t/ch", t, codec=codec)
+    ch.publish(1, t)
+    _, got, _ = ch.read()
+    assert np.asarray(got["s"]).item() == 3.5
+    assert got["e"].shape == (0, 4) and got["e"].dtype == np.float32
+    np.testing.assert_array_equal(got["w"], t["w"])
+
+
+def _payload(kv):
+    """All chunk key/values on a KVStore (meta + pointer excluded)."""
+    return {k: v for k, v in kv._d.items()
+            if not (k.endswith("/meta") or k.endswith("/ver"))}
+
+
+@pytest.mark.parametrize("codec", ["raw", "blosc", "int8"])
+@pytest.mark.parametrize("bucket_kb,workers", [(2, 0), (2, 2), (8, 4)])
+def test_bucketed_wire_bitwise_identical_to_blocking(codec, bucket_kb,
+                                                     workers):
+    """The overlap acceptance property: bucketing/threading is purely a
+    schedule — chunk keys, chunk bytes, "chunks" meta, and byte totals all
+    match the blocking wire exactly, for every codec the wire carries."""
+    rng = np.random.default_rng(7)
+    if codec == "int8":
+        # What the int8 trainer path publishes: per-leaf {"v","s"} dicts
+        # (quantized values + scales) through a blosc channel.
+        chan_codec = "blosc"
+        t = {f"l{i}": {"v": rng.integers(-127, 128, (n,), dtype=np.int8),
+                       "s": rng.normal(size=(max(n // 256, 1),))
+                       .astype(np.float32)}
+             for i, n in enumerate([3000, 64, 9000, 1, 700])}
+    else:
+        chan_codec = codec
+        t = {f"l{i}": rng.normal(size=(n,)).astype(np.float32)
+             for i, n in enumerate([700, 3, 1500, 1, 400, 4096])}
+    kv_a, kv_b = KVStore(), KVStore()
+    ch_a = KVPytreeChannel(kv_a, "t/ch", t, codec=chan_codec)
+    ch_b = KVPytreeChannel(kv_b, "t/ch", t, codec=chan_codec,
+                           bucket_bytes=bucket_kb * 1024, workers=workers)
+    ch_a.publish(1, t)
+    ch_b.publish(1, t)
+    import json
+    meta_a = json.loads(kv_a.get("t/ch/1/meta"))
+    meta_b = json.loads(kv_b.get("t/ch/1/meta"))
+    assert meta_a["chunks"] == meta_b["chunks"]
+    # Bucketed publish adds ONLY the "buckets" schedule hint.
+    assert "buckets" not in meta_a
+    assert sum(meta_b["buckets"]) == ch_b.n_leaves
+    assert _payload(kv_a) == _payload(kv_b)
+    assert (ch_a.bytes_out == ch_b.bytes_out
+            == sum(len(v) for v in _payload(kv_a).values()))
+    assert sum(ch_b.last_publish_bucket_bytes) == ch_b.last_publish_bytes
+    # A concurrent reader decodes the identical tree and counts the same
+    # bytes in that the writer counted out.
+    rd = KVPytreeChannel(kv_b, "t/ch", t, codec=chan_codec,
+                         bucket_bytes=bucket_kb * 1024, workers=workers)
+    ver, got, _ = rd.read()
+    assert ver == 1
+    for a, b in zip(jax.tree.leaves(t), jax.tree.leaves(got)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert rd.bytes_in == ch_b.bytes_out
+
+
+def test_bucket_mb_zero_is_exact_legacy_format():
+    """--wire-bucket-mb 0 acceptance: the ENTIRE KV (payload, meta json,
+    pointer) is byte-identical to a channel that predates bucketing."""
+    t = _tree()
+    kv_a, kv_b = KVStore(), KVStore()
+    KVPytreeChannel(kv_a, "t/ch", t).publish(1, t, meta={"step": 4})
+    KVPytreeChannel(kv_b, "t/ch", t, bucket_bytes=0,
+                    workers=4).publish(1, t, meta={"step": 4})
+    assert kv_a._d == kv_b._d
+
+
 def test_transport_param_channel_and_done():
     kv = KVStore()
     tpl = _tree()
