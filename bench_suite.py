@@ -441,6 +441,58 @@ def bench_lm_decode(name, steps, *, batch=1, prompt_len=128, n_new=128,
                 batch * (1 + n_new) / t_full, 1)}
 
 
+def bench_serving(name, steps, *, slots, n_req=8, prompt_len=32, n_new=64,
+                  d_model=128, n_layers=2, n_heads=4, vocab=256,
+                  seq_len=256):
+    """Continuous-batching serving throughput (ps_pytorch_tpu/serving/):
+    ``n_req`` identical-seeded requests drained closed-loop through a
+    ``slots``-wide engine. slots=1 IS the sequential baseline (one request
+    decodes at a time through the same engine mechanics), so the
+    batched/sequential pair isolates what slot-batching buys at the same
+    model, prompts, and sampling seeds. ``tokens_sha256`` hashes every
+    request's sampled tokens in request order — main() asserts the batched
+    and sequential hashes MATCH, which is the slot-count-invariance (and
+    hence generate()-parity) contract inside the artifact itself."""
+    import hashlib
+
+    from ps_pytorch_tpu.models.transformer import TransformerLM
+    from ps_pytorch_tpu.serving.engine import ServingEngine
+    from ps_pytorch_tpu.serving.loadgen import make_requests, run_closed_loop
+
+    model = TransformerLM(vocab_size=vocab, d_model=d_model,
+                          n_layers=n_layers, n_heads=n_heads,
+                          max_seq_len=seq_len)
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, prompt_len), jnp.int32),
+                        positions=jnp.arange(prompt_len))["params"]
+    engine = ServingEngine(params, slots=slots, vocab=vocab, d_model=d_model,
+                           n_layers=n_layers, n_heads=n_heads,
+                           max_seq_len=seq_len)
+    # Warm-up drains the jit cache (prefill at this prompt length, the
+    # vmapped step, the sampler) so the timed loop measures decode, not
+    # compiles. Different seed base -> does not perturb the timed tokens.
+    warm = make_requests(min(slots, 2), prompt_len=prompt_len, n_new=4,
+                         vocab=vocab, seed=9999)
+    run_closed_loop(engine, warm)
+    reqs = make_requests(n_req, prompt_len=prompt_len, n_new=n_new,
+                         vocab=vocab, seed=123)
+    stats = run_closed_loop(engine, reqs)
+    sha = hashlib.sha256(json.dumps(
+        [r.tokens for r in reqs]).encode()).hexdigest()
+    return {"config": name, "platform": jax.devices()[0].platform,
+            "slots": slots, "n_req": n_req, "prompt_len": prompt_len,
+            "n_new": n_new, "d_model": d_model, "n_layers": n_layers,
+            "vocab": vocab,
+            "completed": stats["completed"], "tokens": stats["tokens"],
+            "wall_s": round(stats["wall_s"], 4),
+            "tokens_per_sec": round(stats["tokens_per_sec"], 1),
+            "ttft_p50_ms": round(stats["ttft_p50_ms"], 2),
+            "ttft_p99_ms": round(stats["ttft_p99_ms"], 2),
+            "latency_p50_ms": round(stats["latency_p50_ms"], 2),
+            "latency_p99_ms": round(stats["latency_p99_ms"], 2),
+            "tokens_sha256": sha}
+
+
 def bench_pallas_conv_ab(name, steps, *, batch=1024, hw=32, c=64):
     """A/B: Pallas 3x3 conv prototype vs lax.conv on the trace's hot
     geometry (PERF.md §7: 32x32/64-ch blocks HBM-bound at ~486 GB/s, the
@@ -805,6 +857,14 @@ CONFIGS = {
     "wire_overlapped_64mb": lambda steps: bench_wire(
         "wire_overlapped_64mb", min(steps, 3), payload_mb=64,
         bucket_mb=4, workers=4),
+    # -- serving (ps_pytorch_tpu/serving/): 8 concurrent requests, batched
+    # (8 slots) vs sequential (1 slot) through the same engine. main()
+    # derives serve_batch_win_8 (ISSUE 5 acceptance: >= 1.5x tokens/sec AND
+    # bitwise-identical tokens). --
+    "serve_sequential_8": lambda steps: bench_serving(
+        "serve_sequential_8", steps, slots=1),
+    "serve_batched_8": lambda steps: bench_serving(
+        "serve_batched_8", steps, slots=8),
 }
 
 
@@ -917,6 +977,29 @@ def main(argv=None) -> int:
                "blocking_s": row["total_s"], "overlapped_s": over["total_s"],
                "ratio": round(ratio, 3), "bitwise_identical": bitwise,
                "ok": bool(bitwise and ratio >= 1.25)}
+        print(json.dumps(out), flush=True)
+        rows.append(out)
+
+    # Serving: batched (8 slots) vs sequential (1 slot) aggregate
+    # tokens/sec at 8 concurrent requests, AND the two runs' sampled tokens
+    # must hash identically (slot-count invariance = generate() parity,
+    # proven inside the artifact). ok needs BOTH — a fast engine that
+    # samples different tokens is a broken engine. 1.5x is the ISSUE 5
+    # acceptance bar.
+    seq = next((r for r in rows if r.get("config") == "serve_sequential_8"
+                and "error" not in r), None)
+    bat = next((r for r in rows if r.get("config") == "serve_batched_8"
+                and "error" not in r), None)
+    if seq and bat:
+        ratio = bat["tokens_per_sec"] / max(seq["tokens_per_sec"], 1e-9)
+        bitwise = (seq["tokens_sha256"] == bat["tokens_sha256"])
+        out = {"config": "serve_batch_win_8",
+               "sequential_tokens_per_sec": seq["tokens_per_sec"],
+               "batched_tokens_per_sec": bat["tokens_per_sec"],
+               "ratio": round(ratio, 3), "bitwise_identical": bitwise,
+               "ttft_p99_ms": bat["ttft_p99_ms"],
+               "latency_p99_ms": bat["latency_p99_ms"],
+               "ok": bool(bitwise and ratio >= 1.5)}
         print(json.dumps(out), flush=True)
         rows.append(out)
 

@@ -65,6 +65,10 @@ def main(argv=None) -> int:
     prompt_bytes = args.prompt.encode("utf-8")
     if not prompt_bytes:
         p.error("--prompt must be non-empty")
+    if args.n_new < 1:
+        p.error(f"--n-new {args.n_new} (need >= 1)")
+    if args.top_k < 0:
+        p.error(f"--top-k {args.top_k} (need >= 0; 0 = no truncation)")
     if max(prompt_bytes) >= cfg.lm_vocab:
         # Embed would silently clamp out-of-range ids inside jit.
         p.error(f"prompt contains byte {max(prompt_bytes)} but the "

@@ -118,6 +118,17 @@ class TrainConfig:
     kv_retry_budget: int = 1000      # run-wide retry budget before failing fast; 0 = unbounded
     ckpt_keep: int = 0               # keep-last-N committed checkpoints; 0 = keep all
     auto_resume: int = 0             # max automatic restarts from the latest VALID checkpoint after a crash (train.py)
+    leader_lease_s: float = 0.0      # leader refreshes a coordination-KV lease this often; followers raise LeaderLost when it goes stale (0 = lease off; runtime/coordinator.py)
+
+    # -- serving (serve.py + ps_pytorch_tpu/serving/: continuous-batching
+    #    inference over trained LM checkpoints with hot reload) --
+    serve_slots: int = 8             # concurrent decode slots (the continuous batch)
+    serve_max_queue: int = 64        # admission queue depth before 503 backpressure
+    serve_reload_s: float = 10.0     # checkpoint poll interval in seconds; 0 = hot reload off
+    serve_port: int = 8300           # HTTP port; 0 = ephemeral
+    serve_host: str = "127.0.0.1"
+    serve_deadline_s: float = 30.0   # default per-request deadline; queued past it -> shed (504)
+    serve_max_new: int = 128         # default n_new when the request doesn't set one
 
     # -- logging / profiling / telemetry --
     log_every: int = 1
@@ -185,6 +196,23 @@ class TrainConfig:
                 self.auto_resume < 0:
             raise ValueError("ckpt_keep / kv_retry_budget / auto_resume "
                              "must be >= 0")
+        if self.leader_lease_s < 0:
+            raise ValueError(f"leader_lease_s={self.leader_lease_s} "
+                             "(must be >= 0; 0 = lease off)")
+        if self.serve_slots < 1:
+            raise ValueError(f"serve_slots={self.serve_slots} (must be >= 1)")
+        if self.serve_max_queue < 1:
+            raise ValueError(f"serve_max_queue={self.serve_max_queue} "
+                             "(must be >= 1)")
+        if self.serve_max_new < 1:
+            raise ValueError(f"serve_max_new={self.serve_max_new} "
+                             "(must be >= 1)")
+        if self.serve_reload_s < 0 or self.serve_deadline_s <= 0:
+            raise ValueError("serve_reload_s must be >= 0 and "
+                             "serve_deadline_s > 0")
+        if self.serve_port < 0:
+            raise ValueError(f"serve_port={self.serve_port} "
+                             "(must be >= 0; 0 = ephemeral)")
         if self.mode == "async" and self.publish_every > max(self.staleness_limit, 1):
             # Followers only ever see published versions: a publish gap
             # wider than the staleness window makes EVERY follower gradient

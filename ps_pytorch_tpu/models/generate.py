@@ -63,6 +63,14 @@ def generate(params, prompt, *, n_new: int, vocab: int, d_model: int,
     if s0 == 0:
         raise ValueError("prompt must be non-empty (the first sampled "
                          "token is conditioned on its last logits)")
+    if n_new < 1:
+        # n_new=0 would silently return the prompt; negative would reach
+        # lax.scan as a bad length mid-trace.
+        raise ValueError(f"n_new={n_new} (must be >= 1)")
+    if top_k < 0:
+        # Negative top_k would silently skip truncation (the `top_k > 0`
+        # gate) while LOOKING like a strict cutoff to the caller.
+        raise ValueError(f"top_k={top_k} (must be >= 0; 0 = no truncation)")
     total = s0 + n_new
     if total > max_seq_len:
         raise ValueError(f"prompt ({s0}) + n_new ({n_new}) exceeds "

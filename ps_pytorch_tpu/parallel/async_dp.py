@@ -86,10 +86,7 @@ class StaleGradientAggregator:
             raise ValueError(f"slice_id {slice_id} out of range")
         leaves, treedef = jax.tree.flatten(grads)
         if self.compress and self.codec == "int8":
-            from ps_pytorch_tpu.ops import quantize_int8
-            key = jax.random.key((hash((slice_id, step)) & 0x7FFFFFFF))
-            leaves = [quantize_int8(l, jax.random.fold_in(key, i))
-                      for i, l in enumerate(leaves)]
+            leaves = self._quantize_leaves(leaves, slice_id, step)
         elif self.compress:
             leaves = self._compress_leaves(leaves)
         # No codec: pool leaves as submitted. In-process callers hand device
@@ -120,6 +117,36 @@ class StaleGradientAggregator:
                               for l in block],
             pool)
         return [c for block in out for c in block]
+
+    def _quantize_leaves(self, leaves: List[Any], slice_id: int,
+                         step: int) -> List[Any]:
+        """int8 on the same per-bucket schedule as blosc: quantize bucket k
+        while bucket k+1's gradients are still landing on device, instead of
+        stalling on the whole tree first (ROADMAP wire item).
+
+        The stochastic-rounding key is folded per GLOBAL leaf index
+        (``b.start + j``), so the quantized payload is bitwise-identical to
+        the old whole-tree-before-bucketing pass at every bucket size
+        (pinned in tests/test_buckets.py)."""
+        from ps_pytorch_tpu.ops import quantize_int8
+        from ps_pytorch_tpu.parallel.buckets import plan_buckets, stream_buckets
+        key = jax.random.key((hash((slice_id, step)) & 0x7FFFFFFF))
+        buckets = plan_buckets(leaves, self.wire_bucket_bytes)
+        pool = None
+        if self.wire_workers > 1 and len(buckets) > 1:
+            if self._executor is None:
+                from concurrent.futures import ThreadPoolExecutor
+                self._executor = ThreadPoolExecutor(
+                    max_workers=self.wire_workers,
+                    thread_name_prefix="agg-wire")
+            pool = self._executor
+        out = stream_buckets(
+            leaves, buckets,
+            lambda b, block: [
+                quantize_int8(l, jax.random.fold_in(key, b.start + j))
+                for j, l in enumerate(block)],
+            pool)
+        return [q for block in out for q in block]
 
     def wire_bytes(self) -> int:
         """Bytes currently pooled (what crossed / would cross DCN)."""

@@ -118,7 +118,7 @@ class AsyncTrainer:
         # publish cost hides under the tail of backward instead of landing
         # after it. 0 restores the blocking single-payload schedule.
         wire_bucket_bytes = int(cfg.wire_bucket_mb * (1 << 20))
-        self._wire_overlap = wire_bucket_bytes > 0 and not self._wire_int8
+        self._wire_overlap = wire_bucket_bytes > 0
         self.transport = KVGradientTransport(
             kv, self.n, grad_template=grad_template,
             param_template=param_template, run_id=f"async-{cfg.seed}",
@@ -221,7 +221,15 @@ class AsyncTrainer:
         enc = []
         for i, leaf in enumerate(leaves):
             qt = quantize_int8(leaf, jax.random.fold_in(key, i))
-            enc.append({"v": np.asarray(qt.values), "s": np.asarray(qt.scales)})
+            if self._wire_overlap:
+                # Hand the quantized components to the channel as DEVICE
+                # arrays: its per-bucket sync then overlaps the quantize of
+                # bucket k+1 with the encode/put of bucket k, instead of
+                # stalling here on the whole tree.
+                enc.append({"v": qt.values, "s": qt.scales})
+            else:
+                enc.append({"v": np.asarray(qt.values),
+                            "s": np.asarray(qt.scales)})
         return jax.tree.unflatten(treedef, enc)
 
     def _decode_grads(self, wire):

@@ -36,6 +36,14 @@ import numpy as np
 from ps_pytorch_tpu.telemetry.trace import span as _span
 
 
+class LeaderLost(RuntimeError):
+    """The leader's lease went stale while a follower waited on it.
+
+    Raised from the follower's mask wait so a dead leader surfaces as a
+    clear, immediate signal instead of a 300 s TimeoutError with no cause
+    attached (ROADMAP leader-failover item, first step: DETECTION)."""
+
+
 class KVStore:
     """Minimal KV interface. In-process default; DistributedKV over the JAX
     coordination service for multi-host (replaces MPI tags over DCN)."""
@@ -97,7 +105,8 @@ class Coordinator:
                  num_aggregate: int = 0, kill_threshold: float = 0.0,
                  kv: Optional[KVStore] = None, run_id: str = "run",
                  leader: bool = True, mask_gc_window: int = 50,
-                 liveness=None):
+                 liveness=None, lease_interval_s: float = 0.0,
+                 lease_timeout_s: float = 0.0, clock=None):
         if mode not in ("sync", "kofn", "async"):
             raise ValueError(f"unknown mode {mode!r}")
         if mode == "kofn" and not (0 < num_aggregate <= n_replicas):
@@ -116,6 +125,17 @@ class Coordinator:
         # different failure than a SLOW one (kofn/deadline act on durations
         # a dead host stops reporting).
         self.liveness = liveness
+        # Leader lease (--leader-lease-s): the leader refreshes one KV key
+        # alongside its other control-plane writes; followers treat a stale
+        # lease as leader DEATH and raise LeaderLost from the mask wait
+        # instead of stalling to the run deadline. 0 = lease off. Both ends
+        # share a clock domain — wall time by default, one ManualClock in
+        # tests (same contract as resilience/heartbeat.py).
+        self.lease_interval_s = float(lease_interval_s)
+        self.lease_timeout_s = float(lease_timeout_s) or \
+            3.0 * self.lease_interval_s
+        self.clock = clock or time.time
+        self._lease_last = float("-inf")
         self.stats: Dict[str, int] = {"mask_changes": 0}
         # Follower mask-wait backoff (resilience/retry.py): starts at the
         # old 2 ms poll, backs off exponentially to 100 ms, jittered so N
@@ -197,8 +217,11 @@ class Coordinator:
         resilience/retry.py policy, de-synchronized across followers by the
         replica-count seed) instead of the old fixed 2 ms hammer, and
         TRANSIENT KV errors are absorbed as "not published yet" rather than
-        killing the follower mid-wait. The deadline is still authoritative:
-        a leader that never publishes remains a TimeoutError."""
+        killing the follower mid-wait. The deadline is still authoritative
+        (a leader that never publishes remains a TimeoutError) — but with a
+        leader lease configured, a STALE lease short-circuits the wait into
+        LeaderLost: "the leader is dead" is a different, actionable failure
+        vs "the leader is slow"."""
         deadline = time.monotonic() + timeout_s
         attempt = 0
         while True:
@@ -213,6 +236,7 @@ class Coordinator:
                 v = None
             if v is not None:
                 return np.asarray(json.loads(v), np.float32)
+            self._check_lease(step)
             now = time.monotonic()
             if now > deadline:
                 raise TimeoutError(f"no mask published for step {step}")
@@ -223,7 +247,47 @@ class Coordinator:
             # instead of overflowing multiplier**attempt.
             attempt = min(attempt + 1, 30)
 
+    # ---- leader lease (death detection; resilience/heartbeat.py idiom) ----
+    def _refresh_lease(self, step: int) -> None:
+        """Leader-side: refresh the lease key, throttled to the interval
+        (one tiny KV write per interval, rides the mask publish cadence)."""
+        if self.lease_interval_s <= 0 or not self.leader:
+            return
+        now = self.clock()
+        if now - self._lease_last < self.lease_interval_s:
+            return
+        self._lease_last = now
+        self.kv.set(f"{self.run_id}/lease", json.dumps([step, now]))
+
+    def _check_lease(self, step: int) -> None:
+        """Follower-side: raise LeaderLost when the lease exists but went
+        stale. A never-published lease is bootstrap grace (the leader may
+        not have reached its first publish); transient KV errors are
+        absorbed exactly like the mask read itself."""
+        if self.lease_interval_s <= 0 or self.leader:
+            return
+        try:
+            v = self.kv.get(f"{self.run_id}/lease")
+        except Exception as e:
+            from ps_pytorch_tpu.resilience.retry import is_retryable
+            if not is_retryable(e):
+                raise
+            self.stats["mask_wait_errors"] = \
+                self.stats.get("mask_wait_errors", 0) + 1
+            return
+        if v is None:
+            return
+        lease_step, ts = json.loads(v)
+        age = self.clock() - ts
+        if age > self.lease_timeout_s:
+            self.stats["leader_lost"] = self.stats.get("leader_lost", 0) + 1
+            raise LeaderLost(
+                f"leader lease stale by {age:.2f}s (> {self.lease_timeout_s}"
+                f"s) waiting for step {step}'s mask; last refresh at its "
+                f"step {lease_step}")
+
     def _decide_and_publish_mask(self, key: str, step: int) -> np.ndarray:
+        self._refresh_lease(step)
         mask = self._decide_mask()
         # Observability: one stable line whenever the decision changes (the
         # reference's only straggler evidence was per-worker timing logs).

@@ -107,7 +107,8 @@ class Trainer:
             coordinator = Coordinator(
                 self.n_data, mode=cfg.mode, num_aggregate=cfg.num_aggregate,
                 kill_threshold=cfg.kill_threshold, kv=kv,
-                leader=jax.process_index() == 0)
+                leader=jax.process_index() == 0,
+                lease_interval_s=cfg.leader_lease_s)
         self.coordinator = coordinator
         # Data-axis replica indices whose devices live on this host (for
         # duration telemetry feeding the kofn/deadline policies).

@@ -1,0 +1,28 @@
+"""Serving subsystem — continuous-batching inference over trained LM
+checkpoints (beyond parity; the reference stops at a polling evaluator).
+
+The pieces compose bottom-up and each is usable alone:
+
+- ``engine``   slot-based continuous-batching decode engine (``ServingEngine``)
+               + the request object (``Request``) + the drive loop
+               (``serve_loop``). Decode output is bit-identical to one-shot
+               ``models/generate.generate`` for the same request/seed.
+- ``queue``    bounded admission queue with backpressure and deadline
+               shedding (``AdmissionQueue``).
+- ``reload``   hot checkpoint reload: poll the train dir like the evaluator,
+               swap params between decode steps (``CheckpointWatcher``).
+- ``server``   stdlib ``ThreadingHTTPServer`` JSON front-end
+               (``ServingFrontend``) — no new dependencies.
+- ``loadgen``  closed/open-loop synthetic load generation reporting
+               TTFT / p50 / p99 / tokens-per-sec.
+
+Entry point: ``serve.py`` at the repo root (flags in ``config.py``:
+``--serve-slots`` / ``--serve-max-queue`` / ``--serve-reload-s`` ...).
+"""
+
+from ps_pytorch_tpu.serving.engine import Request, ServingEngine, serve_loop
+from ps_pytorch_tpu.serving.queue import AdmissionQueue
+from ps_pytorch_tpu.serving.reload import CheckpointWatcher
+
+__all__ = ["Request", "ServingEngine", "serve_loop", "AdmissionQueue",
+           "CheckpointWatcher"]

@@ -1,0 +1,381 @@
+"""Slot-based continuous-batching decode engine.
+
+The serving problem is the training problem inverted: instead of one big
+fixed-shape step over a static batch, requests of different lengths arrive
+at different times and each wants tokens back as soon as possible. The
+TPU-idiomatic answer is still fixed shapes: the engine owns N decode
+*slots*, each a fixed-length k/v cache (``Block.decode`` — the same cache
+``models/generate.py`` uses), and ONE jitted vmapped single-token step over
+all N slots runs every engine tick. Requests are admitted into free slots
+and evicted the moment their last token is sampled, so short and long
+requests interleave with zero recompilation — admission changes which rows
+carry live state, never the compiled program.
+
+Bitwise parity with ``generate()`` is a hard contract, not an aspiration:
+slot decode reuses the exact model construction, the exact ``_sample``, and
+the exact per-request key schedule (``key = jax.random.key(seed)``; each
+token ``key, sub = split(key)``), and each slot's cache row is independent
+under ``vmap``, so the tokens a request receives are identical whether it
+decoded alone through ``generate()`` or interleaved with seven strangers
+(pinned by tests/test_serving.py across slot counts).
+
+Two compile-shape notes:
+
+- the per-token step is compiled ONCE per engine (shape ``[slots]``);
+- prefill is jitted per distinct prompt LENGTH (exact-length prefill is
+  what keeps parity with ``generate()``'s one-shot prefill; serve traffic
+  clusters on few lengths, so the jit cache absorbs this).
+
+Hot reload: params are an ARGUMENT of every jitted function, never a
+closure — ``set_params`` between ticks swaps the model without recompiling
+and without touching in-flight caches (serving/reload.py drives it).
+"""
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ps_pytorch_tpu.models.generate import _sample
+from ps_pytorch_tpu.models.transformer import TransformerLM
+from ps_pytorch_tpu.telemetry.trace import span as _span
+
+
+@dataclass
+class Request:
+    """One generation request moving through admission → decode → done.
+
+    ``prompt`` is int32 token ids (the byte-level LM's bytes); sampling
+    params mirror ``generate()``. ``deadline_t`` is an ABSOLUTE clock value
+    (queue.py sheds requests whose deadline passes before admission).
+    The lifecycle fills ``tokens`` / ``state`` / the timestamps; ``wait``
+    blocks a server thread until the engine resolves the request."""
+
+    prompt: np.ndarray
+    n_new: int
+    temperature: float = 0.8
+    top_k: int = 40
+    seed: int = 0
+    rid: str = ""
+    deadline_t: Optional[float] = None
+
+    # -- lifecycle (engine/queue-owned) --
+    tokens: List[int] = field(default_factory=list)
+    state: str = "new"       # new|queued|active|done|shed|rejected|failed
+    error: str = ""
+    model_step: Optional[int] = None   # checkpoint step that admitted it
+    t_submit: float = 0.0
+    t_admit: float = 0.0
+    t_first: float = 0.0     # first token available (TTFT reference point)
+    t_done: float = 0.0
+
+    def __post_init__(self):
+        self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
+        self._event = threading.Event()
+        # per-request sampling chain (engine-owned; mirrors generate()'s
+        # carried key exactly)
+        self._key = None
+
+    def _resolve(self, state: str, error: str = "") -> None:
+        self.state = state
+        self.error = error
+        self._event.set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until the request resolves (done/shed/rejected/failed)."""
+        return self._event.wait(timeout)
+
+
+class ServingEngine:
+    """N fixed-length decode slots + one vmapped single-token step.
+
+    ``cache_len`` bounds prompt+generation per request (defaults to
+    ``max_seq_len``, the positional table's length). ``registry`` is an
+    optional telemetry Registry with the serving metrics declared
+    (telemetry/registry.declare_serving_metrics)."""
+
+    def __init__(self, params, *, slots: int, vocab: int, d_model: int,
+                 n_layers: int, n_heads: int, max_seq_len: int,
+                 cache_len: int = 0, dtype: Any = jnp.float32,
+                 model_step: Optional[int] = None, registry=None,
+                 clock: Callable[[], float] = time.monotonic):
+        if slots < 1:
+            raise ValueError(f"slots={slots} (need >= 1)")
+        cache_len = int(cache_len) or int(max_seq_len)
+        if cache_len > max_seq_len:
+            raise ValueError(f"cache_len {cache_len} > max_seq_len "
+                             f"{max_seq_len} (the positional table bounds "
+                             f"decodable length)")
+        self.slots = int(slots)
+        self.vocab = int(vocab)
+        self.cache_len = cache_len
+        self.model_step = model_step
+        self.registry = registry
+        self.clock = clock
+        self.model = TransformerLM(vocab_size=vocab, d_model=d_model,
+                                   n_layers=n_layers, n_heads=n_heads,
+                                   max_seq_len=max_seq_len, dtype=dtype,
+                                   attention_impl="full", decode=True,
+                                   decode_cache_len=cache_len)
+        self._params = params
+        self._lock = threading.Lock()   # guards params swap vs tick
+
+        # Stacked per-slot caches: leaf [slots, *B1-cache-shape]. A fresh
+        # zero cache is fine — a slot's rows are fully overwritten by its
+        # admission prefill before any decode reads them.
+        _, vars_ = self.model.apply(
+            {"params": params}, jnp.zeros((1, 1), jnp.int32),
+            positions=jnp.zeros(1, jnp.int32), mutable=["cache"])
+        self._cache = jax.tree.map(
+            lambda a: jnp.zeros((self.slots,) + a.shape, a.dtype),
+            vars_["cache"])
+
+        def slot_step(p, cache, tok, pos):
+            out, cvars = self.model.apply(
+                {"params": p, "cache": cache}, tok[None, None],
+                positions=pos[None], mutable=["cache"])
+            return cvars["cache"], out[0, 0]
+
+        # ONE compiled program for every tick, shape [slots]; params are an
+        # argument so hot reload never recompiles.
+        self._vstep = jax.jit(jax.vmap(slot_step, in_axes=(None, 0, 0, 0)))
+
+        def prefill(p, prompt):
+            out, cvars = self.model.apply(
+                {"params": p}, prompt,
+                positions=jnp.arange(prompt.shape[1], dtype=jnp.int32),
+                mutable=["cache"])
+            return cvars["cache"], out[0, -1]
+
+        self._prefill = jax.jit(prefill)      # per distinct prompt length
+
+        def scatter(full, one, i):
+            return jax.tree.map(
+                lambda f, o: jax.lax.dynamic_update_index_in_dim(f, o, i, 0),
+                full, one)
+
+        self._scatter = jax.jit(scatter)
+        self._samplers: Dict[Tuple[float, int], Callable] = {}
+
+        # Host-side slot state (the scheduler; all numpy, no device chatter)
+        self._slot_req: List[Optional[Request]] = [None] * self.slots
+        self._tok = np.zeros(self.slots, np.int32)
+        self._pos = np.zeros(self.slots, np.int32)
+        self.ticks = 0
+        self.served = 0
+        self.tokens_out = 0
+
+    # ---- capacity ----
+    @property
+    def active_count(self) -> int:
+        return sum(r is not None for r in self._slot_req)
+
+    @property
+    def free_slots(self) -> int:
+        return self.slots - self.active_count
+
+    def active_requests(self) -> List[Request]:
+        return [r for r in self._slot_req if r is not None]
+
+    # ---- sampling (generate()'s _sample, jitted per (temperature, top_k)) ----
+    def _sampler(self, temperature: float, top_k: int) -> Callable:
+        sig = (float(temperature), int(top_k))
+        fn = self._samplers.get(sig)
+        if fn is None:
+            t, k = sig
+            fn = jax.jit(lambda logits, key: _sample(logits, key, t, k))
+            self._samplers[sig] = fn
+        return fn
+
+    def _emit(self, req: Request, logits_row) -> int:
+        """Sample the next token for ``req`` from its [V] logits row using
+        generate()'s exact key schedule; returns the token."""
+        req._key, sub = jax.random.split(req._key)
+        tok = int(self._sampler(req.temperature, req.top_k)(
+            logits_row[None], sub)[0])
+        if not req.tokens:
+            req.t_first = self.clock()
+        req.tokens.append(tok)
+        self.tokens_out += 1
+        if self.registry is not None:
+            self.registry.inc("serve_tokens")
+        return tok
+
+    def _complete(self, req: Request) -> None:
+        req.t_done = self.clock()
+        self.served += 1
+        req._resolve("done")
+        if self.registry is not None:
+            self.registry.inc("serve_requests")
+            if req.t_submit:
+                self.registry.observe("serve_request_latency_s",
+                                      req.t_done - req.t_submit)
+                if req.t_first:
+                    self.registry.observe("serve_ttft_s",
+                                          req.t_first - req.t_submit)
+
+    # ---- admission ----
+    def validate(self, req: Request) -> None:
+        """Config-time request validation (friendly errors, never
+        trace-time): mirrors generate()'s bounds plus the engine's."""
+        s0 = len(req.prompt)
+        if s0 == 0:
+            raise ValueError("prompt must be non-empty")
+        if req.n_new < 1:
+            raise ValueError(f"n_new={req.n_new} (must be >= 1)")
+        if req.top_k < 0:
+            raise ValueError(f"top_k={req.top_k} (must be >= 0; "
+                             "0 = no truncation)")
+        if req.temperature < 0:
+            raise ValueError(f"temperature={req.temperature} (must be >= 0; "
+                             "0 = greedy)")
+        if s0 and int(req.prompt.max()) >= self.vocab:
+            raise ValueError(f"prompt token {int(req.prompt.max())} out of "
+                             f"vocabulary ({self.vocab})")
+        if s0 and int(req.prompt.min()) < 0:
+            raise ValueError("prompt tokens must be >= 0")
+        if s0 + req.n_new > self.cache_len:
+            raise ValueError(f"prompt ({s0}) + n_new ({req.n_new}) exceeds "
+                             f"the engine cache length ({self.cache_len})")
+
+    def admit(self, req: Request) -> bool:
+        """Prefill ``req`` into a free slot (False when all slots busy).
+
+        Raises ValueError for an invalid request (the caller resolves it as
+        failed). The first token is sampled HERE from the prefill's last
+        logits — exactly generate()'s first scan iteration — so TTFT is one
+        prefill away from admission, and an ``n_new == 1`` request never
+        occupies a slot at all."""
+        self.validate(req)
+        try:
+            i = self._slot_req.index(None)
+        except ValueError:
+            return False
+        with _span("serve_admit", slot=i, prompt_len=len(req.prompt),
+                   n_new=req.n_new), self._lock:
+            req.t_admit = self.clock()
+            req.state = "active"
+            req.model_step = self.model_step
+            s0 = len(req.prompt)
+            cache1, last_logits = self._prefill(
+                self._params, jnp.asarray(req.prompt[None]))
+            req._key = jax.random.key(req.seed)
+            tok = self._emit(req, last_logits)
+            if req.n_new == 1:
+                self._complete(req)
+                return True
+            self._cache = self._scatter(self._cache, cache1, i)
+            self._slot_req[i] = req
+            self._tok[i] = tok
+            self._pos[i] = s0
+        if self.registry is not None:
+            self.registry.set("serve_active_slots", self.active_count)
+        return True
+
+    # ---- the tick ----
+    def step(self) -> List[Tuple[Request, int]]:
+        """One engine tick: a single vmapped decode over all slots, then a
+        per-active-slot sample. Returns [(request, token)] emissions;
+        requests whose last token was just sampled are evicted (their slot
+        is free for the NEXT admit — generate()'s discarded final forward
+        is simply never run for them).
+
+        Inactive slots decode garbage harmlessly (pos 0 masks their
+        attention to one cached row; their logits are dropped)."""
+        live = [(i, r) for i, r in enumerate(self._slot_req) if r is not None]
+        if not live:
+            return []
+        emissions: List[Tuple[Request, int]] = []
+        with _span("serve_decode", active=len(live)), self._lock:
+            self._cache, logits = self._vstep(
+                self._params, self._cache,
+                jnp.asarray(self._tok), jnp.asarray(self._pos))
+            self.ticks += 1
+            for i, req in live:
+                tok = self._emit(req, logits[i])
+                emissions.append((req, tok))
+                if len(req.tokens) >= req.n_new:
+                    self._slot_req[i] = None
+                    self._complete(req)
+                else:
+                    self._tok[i] = tok
+                    self._pos[i] += 1
+        if self.registry is not None:
+            self.registry.set("serve_active_slots", self.active_count)
+        return emissions
+
+    # ---- hot reload (serving/reload.py) ----
+    def set_params(self, params, step: Optional[int] = None) -> None:
+        """Swap the served checkpoint between ticks. In-flight requests keep
+        their caches and finish under the new params (their already-sampled
+        tokens are history; nothing is dropped)."""
+        with _span("serve_reload", step=step), self._lock:
+            self._params = params
+            if step is not None:
+                self.model_step = step
+        if self.registry is not None:
+            self.registry.inc("serve_reloads")
+            if step is not None:
+                self.registry.set("serve_model_step", step)
+
+    # ---- convenience (tests / loadgen) ----
+    def run_to_completion(self, requests: List[Request],
+                          max_ticks: int = 100_000) -> None:
+        """Drive admit+step inline until every request resolves (closed
+        loop, no threads). Requests are admitted in order as slots free."""
+        pending = list(requests)
+        for r in pending:
+            if not r.t_submit:
+                r.t_submit = self.clock()
+        ticks = 0
+        while pending or self.active_count:
+            while pending and self.free_slots:
+                req = pending.pop(0)
+                try:
+                    self.admit(req)
+                except ValueError as e:
+                    req._resolve("failed", str(e))
+            if self.active_count:
+                self.step()
+            ticks += 1
+            if ticks > max_ticks:
+                raise RuntimeError("run_to_completion exceeded max_ticks")
+
+
+def serve_loop(engine: ServingEngine, queue, *, watcher=None,
+               reload_s: float = 10.0, stop: Optional[threading.Event] = None,
+               idle_wait_s: float = 0.02,
+               clock: Callable[[], float] = time.monotonic) -> None:
+    """The serving drive loop (one thread): admit from the queue while slots
+    are free, tick the engine while anything is active, and poll the
+    checkpoint watcher every ``reload_s`` — params swap BETWEEN ticks, so a
+    reload never lands mid-decode. Runs until ``stop`` is set."""
+    last_reload = clock()
+    while stop is None or not stop.is_set():
+        admitted = False
+        while engine.free_slots > 0:
+            req = queue.take()
+            if req is None:
+                break
+            try:
+                if engine.admit(req):
+                    admitted = True
+            except ValueError as e:
+                req._resolve("failed", str(e))
+        if engine.active_count:
+            engine.step()
+        elif not admitted:
+            # idle: block briefly on the queue instead of spinning
+            queue.wait_nonempty(idle_wait_s)
+        if (watcher is not None and reload_s > 0
+                and clock() - last_reload >= reload_s):
+            last_reload = clock()
+            got = watcher.poll()
+            if got is not None:
+                engine.set_params(got.params, step=got.step)
+        if engine.registry is not None:
+            engine.registry.set("serve_queue_depth", queue.depth())

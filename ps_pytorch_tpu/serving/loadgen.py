@@ -1,0 +1,111 @@
+"""Synthetic load generation + latency accounting for the serving engine.
+
+Two drive modes, per the usual serving-bench taxonomy:
+
+- **closed loop** (``run_closed_loop``): all requests present at t0, the
+  engine drains them as fast as slots allow — measures aggregate decode
+  THROUGHPUT (tokens/sec) and is deterministic, so bench_suite.py uses it
+  for the batched-vs-sequential win row (same seeds → sha256 over tokens
+  proves slot-count invariance inside the artifact).
+- **open loop** (``run_open_loop``): Poisson arrivals submitted through an
+  ``AdmissionQueue`` while a ``serve_loop`` thread drains it — measures
+  LATENCY under load including queueing (TTFT/p50/p99) and exercises
+  backpressure/shedding. Wall-clock heavy, so its soak test is ``slow``.
+
+``summarize`` turns resolved requests into the stats dict both modes (and
+bench_suite rows) report.
+"""
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ps_pytorch_tpu.serving.engine import Request, ServingEngine, serve_loop
+from ps_pytorch_tpu.serving.queue import AdmissionQueue
+
+
+def make_requests(n: int, *, prompt_len: int, n_new: int, vocab: int,
+                  seed: int = 0, temperature: float = 0.8,
+                  top_k: int = 40) -> List[Request]:
+    """n deterministic requests (prompts drawn from ``seed``; request i
+    samples with seed ``seed + i`` so replays are bit-reproducible)."""
+    rng = np.random.default_rng(seed)
+    reqs = []
+    for i in range(n):
+        prompt = rng.integers(0, vocab, size=prompt_len).astype(np.int32)
+        reqs.append(Request(prompt=prompt, n_new=n_new,
+                            temperature=temperature, top_k=top_k,
+                            seed=seed + i, rid=f"lg-{i}"))
+    return reqs
+
+
+def summarize(requests: List[Request], wall_s: float) -> Dict:
+    """Latency/throughput stats over RESOLVED requests. Only ``done``
+    requests contribute latency percentiles; shed/rejected are counted."""
+    done = [r for r in requests if r.state == "done"]
+    out = {
+        "requests": len(requests),
+        "completed": len(done),
+        "shed": sum(r.state == "shed" for r in requests),
+        "rejected": sum(r.state == "rejected" for r in requests),
+        "failed": sum(r.state == "failed" for r in requests),
+        "wall_s": float(wall_s),
+        "tokens": int(sum(len(r.tokens) for r in done)),
+    }
+    out["tokens_per_sec"] = out["tokens"] / wall_s if wall_s > 0 else 0.0
+    if done:
+        ttft = np.array([r.t_first - r.t_submit for r in done])
+        lat = np.array([r.t_done - r.t_submit for r in done])
+        out.update(
+            ttft_p50_ms=float(np.percentile(ttft, 50) * 1e3),
+            ttft_p99_ms=float(np.percentile(ttft, 99) * 1e3),
+            latency_p50_ms=float(np.percentile(lat, 50) * 1e3),
+            latency_p99_ms=float(np.percentile(lat, 99) * 1e3),
+        )
+    return out
+
+
+def run_closed_loop(engine: ServingEngine, requests: List[Request]) -> Dict:
+    """Drain ``requests`` through the engine inline (no threads, no queue):
+    the deterministic throughput measurement."""
+    t0 = engine.clock()
+    for r in requests:
+        r.t_submit = t0
+    engine.run_to_completion(requests)
+    return summarize(requests, engine.clock() - t0)
+
+
+def run_open_loop(engine: ServingEngine, requests: List[Request], *,
+                  rate_rps: float, max_queue: int = 64,
+                  deadline_s: Optional[float] = None,
+                  arrival_seed: int = 0, timeout_s: float = 120.0) -> Dict:
+    """Submit ``requests`` at Poisson-spaced arrivals (``rate_rps``) into an
+    AdmissionQueue drained by a ``serve_loop`` thread; returns ``summarize``
+    stats over the whole set once every request resolves."""
+    queue = AdmissionQueue(max_queue, clock=engine.clock,
+                           registry=engine.registry)
+    stop = threading.Event()
+    loop = threading.Thread(
+        target=serve_loop, args=(engine, queue),
+        kwargs=dict(reload_s=0.0, stop=stop, clock=engine.clock),
+        daemon=True)
+    loop.start()
+    rng = np.random.default_rng(arrival_seed)
+    gaps = rng.exponential(1.0 / rate_rps, size=len(requests))
+    t0 = engine.clock()
+    try:
+        for req, gap in zip(requests, gaps):
+            time.sleep(float(gap))
+            req.t_submit = engine.clock()
+            if deadline_s is not None:
+                req.deadline_t = req.t_submit + deadline_s
+            queue.submit(req)
+        for req in requests:
+            if not req.wait(timeout_s):
+                req._resolve("failed", "loadgen timeout")
+    finally:
+        stop.set()
+        loop.join(timeout=10.0)
+    return summarize(requests, engine.clock() - t0)

@@ -1,0 +1,83 @@
+"""Bounded admission queue with backpressure and deadline shedding.
+
+The queue is the pressure valve between an unbounded outside world and
+``slots`` of fixed decode capacity: ``submit`` rejects immediately when the
+queue is full (HTTP 503 territory — the caller learns NOW, not after a
+deadline's worth of waiting), and ``take`` sheds requests whose absolute
+deadline already passed while they waited (they would miss it anyway;
+decoding them would only push the next request over too). Both outcomes
+resolve the request object so a waiting server thread unblocks.
+"""
+
+import threading
+import time
+from collections import deque
+from typing import Callable, Optional
+
+from ps_pytorch_tpu.serving.engine import Request
+
+
+class AdmissionQueue:
+    """FIFO with a hard depth bound and deadline-aware ``take``."""
+
+    def __init__(self, max_depth: int, *,
+                 clock: Callable[[], float] = time.monotonic, registry=None):
+        if max_depth < 1:
+            raise ValueError(f"max_depth={max_depth} (need >= 1)")
+        self.max_depth = int(max_depth)
+        self.clock = clock
+        self.registry = registry
+        self._q: deque = deque()
+        self._lock = threading.Lock()
+        self._nonempty = threading.Condition(self._lock)
+        self.submitted = 0
+        self.rejected_full = 0
+        self.shed_deadline = 0
+        self.taken = 0
+
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._q)
+
+    def submit(self, req: Request) -> bool:
+        """Enqueue ``req``; False (and the request resolves ``rejected``)
+        when the queue is at max depth — backpressure, not buffering."""
+        with self._lock:
+            if len(self._q) >= self.max_depth:
+                self.rejected_full += 1
+                if self.registry is not None:
+                    self.registry.inc("serve_rejected")
+                req._resolve("rejected", "queue full")
+                return False
+            req.state = "queued"
+            if not req.t_submit:
+                req.t_submit = self.clock()
+            self._q.append(req)
+            self.submitted += 1
+            self._nonempty.notify()
+        return True
+
+    def take(self) -> Optional[Request]:
+        """Pop the oldest still-viable request (None when empty). Requests
+        whose ``deadline_t`` has passed are shed on the way out."""
+        with self._lock:
+            now = self.clock()
+            while self._q:
+                req = self._q.popleft()
+                if req.deadline_t is not None and now > req.deadline_t:
+                    self.shed_deadline += 1
+                    if self.registry is not None:
+                        self.registry.inc("serve_shed")
+                    req._resolve("shed", "deadline passed while queued")
+                    continue
+                self.taken += 1
+                return req
+        return None
+
+    def wait_nonempty(self, timeout: float) -> bool:
+        """Block up to ``timeout`` for the queue to become non-empty (the
+        drive loop's idle wait — avoids spinning an empty engine)."""
+        with self._lock:
+            if self._q:
+                return True
+            return self._nonempty.wait(timeout)

@@ -1,0 +1,208 @@
+"""Stdlib JSON HTTP front-end for the serving engine — no new dependencies.
+
+One ``ThreadingHTTPServer`` thread per connection parks on its request's
+event while ONE ``serve_loop`` thread drives the engine (admission, batched
+decode, hot reload) — HTTP concurrency never touches jit'd code.
+
+API (JSON in, JSON out):
+
+- ``POST /v1/generate``   body: ``{"prompt": "<utf-8 text>"}`` OR
+  ``{"tokens": [int, ...]}`` plus optional ``n_new`` / ``temperature`` /
+  ``top_k`` / ``seed`` / ``deadline_s``. 200 → ``{"tokens", "text",
+  "ttft_ms", "latency_ms", "model_step", "rid"}``; 400 invalid request;
+  503 queue full (backpressure); 504 deadline shed or timeout.
+- ``GET /healthz``        liveness + slot/queue occupancy.
+- ``GET /stats``          engine/queue counters (+ registry snapshot).
+"""
+
+import json
+import threading
+import time
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+
+from ps_pytorch_tpu.serving.engine import Request, ServingEngine, serve_loop
+from ps_pytorch_tpu.serving.queue import AdmissionQueue
+
+
+class ServingFrontend:
+    """Engine + queue + watcher + HTTP server, one ``start()`` away.
+
+    ``port=0`` binds an ephemeral port (tests); read ``self.port`` after
+    ``start``. ``default_deadline_s`` bounds how long a request may wait
+    end-to-end when the caller doesn't send ``deadline_s``."""
+
+    def __init__(self, engine: ServingEngine, *, watcher=None,
+                 host: str = "127.0.0.1", port: int = 0,
+                 max_queue: int = 64, reload_s: float = 10.0,
+                 default_deadline_s: float = 30.0,
+                 default_n_new: int = 128):
+        self.engine = engine
+        self.queue = AdmissionQueue(max_queue, clock=engine.clock,
+                                    registry=engine.registry)
+        self.watcher = watcher
+        self.reload_s = reload_s
+        self.default_deadline_s = float(default_deadline_s)
+        self.default_n_new = int(default_n_new)
+        self._stop = threading.Event()
+        self._loop: Optional[threading.Thread] = None
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._http_thread: Optional[threading.Thread] = None
+        self._host, self._port = host, port
+        self.port: Optional[int] = None
+
+    # ---- lifecycle ----
+    def start(self) -> None:
+        self._loop = threading.Thread(
+            target=serve_loop, args=(self.engine, self.queue),
+            kwargs=dict(watcher=self.watcher, reload_s=self.reload_s,
+                        stop=self._stop, clock=self.engine.clock),
+            daemon=True, name="serve-loop")
+        self._loop.start()
+        frontend = self
+
+        class Handler(_Handler):
+            fe = frontend
+
+        self._httpd = ThreadingHTTPServer((self._host, self._port), Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._http_thread = threading.Thread(
+            target=self._httpd.serve_forever, kwargs=dict(poll_interval=0.05),
+            daemon=True, name="serve-http")
+        self._http_thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        if self._http_thread is not None:
+            self._http_thread.join(timeout=10.0)
+        if self._loop is not None:
+            self._loop.join(timeout=10.0)
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # ---- request handling (called from HTTP threads) ----
+    def handle_generate(self, body: dict) -> tuple:
+        """(status_code, response_dict) for one POST /v1/generate body."""
+        if "tokens" in body:
+            toks = body["tokens"]
+            if (not isinstance(toks, list)
+                    or not all(isinstance(t, int) for t in toks)):
+                return 400, {"error": "tokens must be a list of ints"}
+            prompt = np.asarray(toks, np.int32)
+        elif "prompt" in body:
+            if not isinstance(body["prompt"], str):
+                return 400, {"error": "prompt must be a string"}
+            prompt = np.frombuffer(
+                body["prompt"].encode("utf-8"), np.uint8).astype(np.int32)
+        else:
+            return 400, {"error": "need 'prompt' (text) or 'tokens' (ints)"}
+        try:
+            n_new = int(body.get("n_new", self.default_n_new))
+            temperature = float(body.get("temperature", 0.8))
+            top_k = int(body.get("top_k", 40))
+            seed = int(body.get("seed", 0))
+            deadline_s = float(body.get("deadline_s",
+                                        self.default_deadline_s))
+        except (TypeError, ValueError) as e:
+            return 400, {"error": f"bad field: {e}"}
+        now = self.engine.clock()
+        req = Request(prompt=prompt, n_new=n_new, temperature=temperature,
+                      top_k=top_k, seed=seed, rid=uuid.uuid4().hex[:12],
+                      deadline_t=now + deadline_s)
+        req.t_submit = now
+        try:
+            self.engine.validate(req)
+        except ValueError as e:
+            return 400, {"error": str(e), "rid": req.rid}
+        if not self.queue.submit(req):
+            return 503, {"error": "queue full", "rid": req.rid}
+        # Park this HTTP thread until the serve loop resolves the request
+        # (grace past the deadline so shedding reports as 504, not timeout).
+        if not req.wait(deadline_s + 5.0):
+            req._resolve("failed", "server wait timeout")
+            return 504, {"error": "timed out", "rid": req.rid}
+        if req.state == "shed":
+            return 504, {"error": req.error, "rid": req.rid}
+        if req.state != "done":
+            return 500, {"error": req.error or req.state, "rid": req.rid}
+        resp = {
+            "rid": req.rid,
+            "tokens": [int(t) for t in req.tokens],
+            "model_step": req.model_step,
+            "ttft_ms": (req.t_first - req.t_submit) * 1e3,
+            "latency_ms": (req.t_done - req.t_submit) * 1e3,
+        }
+        if all(0 <= t < 256 for t in req.tokens):
+            resp["text"] = bytes(req.tokens).decode("utf-8", "replace")
+        return 200, resp
+
+    def stats(self) -> dict:
+        e, q = self.engine, self.queue
+        out = {
+            "slots": e.slots, "active_slots": e.active_count,
+            "model_step": e.model_step, "ticks": e.ticks,
+            "served": e.served, "tokens_out": e.tokens_out,
+            "queue_depth": q.depth(), "submitted": q.submitted,
+            "rejected_full": q.rejected_full,
+            "shed_deadline": q.shed_deadline,
+        }
+        if self.watcher is not None:
+            out["reloads"] = self.watcher.reloads
+            out["skipped_corrupt"] = self.watcher.skipped_corrupt
+        if e.registry is not None:
+            out["metrics"] = e.registry.snapshot()
+        return out
+
+
+class _Handler(BaseHTTPRequestHandler):
+    fe: ServingFrontend = None      # bound per-frontend in start()
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *args):    # quiet: telemetry covers observability
+        pass
+
+    def _send(self, code: int, obj: dict) -> None:
+        payload = json.dumps(obj).encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def do_GET(self):
+        if self.path == "/healthz":
+            e = self.fe.engine
+            self._send(200, {"ok": True, "slots_free": e.free_slots,
+                             "queue_depth": self.fe.queue.depth(),
+                             "model_step": e.model_step})
+        elif self.path == "/stats":
+            self._send(200, self.fe.stats())
+        else:
+            self._send(404, {"error": f"no route {self.path}"})
+
+    def do_POST(self):
+        if self.path != "/v1/generate":
+            self._send(404, {"error": f"no route {self.path}"})
+            return
+        try:
+            n = int(self.headers.get("Content-Length", 0))
+            body = json.loads(self.rfile.read(n) or b"{}")
+            if not isinstance(body, dict):
+                raise ValueError("body must be a JSON object")
+        except (ValueError, json.JSONDecodeError) as e:
+            self._send(400, {"error": f"bad JSON body: {e}"})
+            return
+        code, obj = self.fe.handle_generate(body)
+        self._send(code, obj)

@@ -24,19 +24,31 @@ counters.
 
 import threading
 from dataclasses import dataclass
-from typing import Dict, Optional
+from typing import Dict, Optional, Tuple
+
+# Request-latency style default: sub-ms to minutes, roughly x2 per bucket.
+DEFAULT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                   0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0)
 
 
 @dataclass(frozen=True)
 class MetricSpec:
     name: str
-    kind: str          # "counter" (monotonic) | "gauge" (set to any value)
+    kind: str          # "counter" (monotonic) | "gauge" (set) | "histogram" (observe)
     unit: str = ""
     help: str = ""
+    buckets: Tuple[float, ...] = ()     # histogram upper bounds, ascending
 
     def __post_init__(self):
-        if self.kind not in ("counter", "gauge"):
-            raise ValueError(f"metric kind {self.kind!r} (counter | gauge)")
+        if self.kind not in ("counter", "gauge", "histogram"):
+            raise ValueError(f"metric kind {self.kind!r} "
+                             "(counter | gauge | histogram)")
+        if self.kind == "histogram":
+            bs = tuple(float(b) for b in (self.buckets or DEFAULT_BUCKETS))
+            if list(bs) != sorted(bs) or len(set(bs)) != len(bs):
+                raise ValueError(f"histogram {self.name!r} buckets must be "
+                                 "strictly ascending")
+            object.__setattr__(self, "buckets", bs)
 
 
 class Registry:
@@ -47,6 +59,7 @@ class Registry:
     def __init__(self):
         self._specs: Dict[str, MetricSpec] = {}
         self._values: Dict[str, float] = {}
+        self._hists: Dict[str, dict] = {}
         self._lock = threading.Lock()
 
     def counter(self, name: str, unit: str = "", help: str = "") -> str:
@@ -55,6 +68,11 @@ class Registry:
     def gauge(self, name: str, unit: str = "", help: str = "") -> str:
         return self._declare(MetricSpec(name, "gauge", unit, help))
 
+    def histogram(self, name: str, unit: str = "", help: str = "",
+                  buckets: Optional[Tuple[float, ...]] = None) -> str:
+        return self._declare(
+            MetricSpec(name, "histogram", unit, help, tuple(buckets or ())))
+
     def _declare(self, spec: MetricSpec) -> str:
         with self._lock:
             old = self._specs.get(spec.name)
@@ -62,7 +80,15 @@ class Registry:
                 raise ValueError(f"metric {spec.name!r} re-declared as "
                                  f"{spec.kind}, was {old.kind}")
             self._specs[spec.name] = spec
-            self._values.setdefault(spec.name, 0.0)
+            if spec.kind == "histogram":
+                self._hists.setdefault(spec.name, {
+                    # counts[i] = observations <= buckets[i]; last = +Inf
+                    "counts": [0] * (len(spec.buckets) + 1),
+                    "sum": 0.0, "count": 0,
+                    "min": None, "max": None,
+                })
+            else:
+                self._values.setdefault(spec.name, 0.0)
         return spec.name
 
     def _spec(self, name: str, kind: str) -> MetricSpec:
@@ -87,6 +113,53 @@ class Registry:
             self._values[name] = float(value)
             return self._values[name]
 
+    def observe(self, name: str, value: float) -> None:
+        """Record one histogram observation (e.g. a request latency)."""
+        spec = self._spec(name, "histogram")
+        v = float(value)
+        with self._lock:
+            h = self._hists[name]
+            i = 0
+            while i < len(spec.buckets) and v > spec.buckets[i]:
+                i += 1
+            h["counts"][i] += 1
+            h["sum"] += v
+            h["count"] += 1
+            h["min"] = v if h["min"] is None else min(h["min"], v)
+            h["max"] = v if h["max"] is None else max(h["max"], v)
+
+    def _quantile_locked(self, spec: MetricSpec, h: dict, q: float) -> float:
+        """Prometheus-style bucket interpolation, clamped to the observed
+        [min, max] so quantiles never exceed what was actually seen."""
+        rank = q * h["count"]
+        seen = 0
+        for i, c in enumerate(h["counts"]):
+            if c == 0:
+                continue
+            if seen + c >= rank:
+                lo = spec.buckets[i - 1] if i > 0 else 0.0
+                hi = (spec.buckets[i] if i < len(spec.buckets)
+                      else h["max"])
+                frac = (rank - seen) / c
+                est = lo + (hi - lo) * frac
+                return min(max(est, h["min"]), h["max"])
+            seen += c
+        return h["max"]
+
+    def hist_summary(self, name: str) -> dict:
+        """{"count", "sum", "min", "max", "p50", "p99"} (empty histogram →
+        count 0 and None everywhere else)."""
+        spec = self._spec(name, "histogram")
+        with self._lock:
+            h = self._hists[name]
+            if h["count"] == 0:
+                return {"count": 0, "sum": 0.0, "min": None, "max": None,
+                        "p50": None, "p99": None}
+            return {"count": h["count"], "sum": h["sum"],
+                    "min": h["min"], "max": h["max"],
+                    "p50": self._quantile_locked(spec, h, 0.50),
+                    "p99": self._quantile_locked(spec, h, 0.99)}
+
     def get(self, name: str) -> float:
         with self._lock:
             if name not in self._specs:
@@ -94,8 +167,14 @@ class Registry:
             return self._values[name]
 
     def snapshot(self) -> Dict[str, float]:
+        """Counters/gauges as floats; each histogram as its summary dict
+        (additive — existing consumers only read the scalar fields)."""
         with self._lock:
-            return dict(self._values)
+            out = dict(self._values)
+        for name, spec in list(self.specs().items()):
+            if spec.kind == "histogram":
+                out[name] = self.hist_summary(name)
+        return out
 
     def specs(self) -> Dict[str, MetricSpec]:
         with self._lock:
@@ -127,6 +206,40 @@ def declare_resilience_metrics(registry: Registry) -> Registry:
     """Declare every resilience counter on ``registry`` (all monotonic)."""
     for name, unit, help_ in RESILIENCE_COUNTERS:
         registry.counter(name, unit=unit, help=help_)
+    return registry
+
+
+# ---- serving metric contract (ps_pytorch_tpu/serving/) ----
+#
+# Same discipline as RESILIENCE_COUNTERS: the one reviewable list of what
+# the serving plane emits. Counters/gauges are (name, unit, help);
+# histograms observe seconds with the DEFAULT_BUCKETS latency ladder.
+SERVING_COUNTERS = (
+    ("serve_requests", "requests", "requests completed"),
+    ("serve_tokens", "tokens", "tokens sampled across all requests"),
+    ("serve_rejected", "requests", "requests rejected at admission (queue full)"),
+    ("serve_shed", "requests", "requests shed for a passed deadline"),
+    ("serve_reloads", "events", "hot checkpoint reloads applied"),
+)
+SERVING_GAUGES = (
+    ("serve_active_slots", "slots", "decode slots currently occupied"),
+    ("serve_queue_depth", "requests", "admission queue depth"),
+    ("serve_model_step", "step", "checkpoint step currently served"),
+)
+SERVING_HISTOGRAMS = (
+    ("serve_request_latency_s", "s", "submit -> last token latency"),
+    ("serve_ttft_s", "s", "submit -> first token latency (TTFT)"),
+)
+
+
+def declare_serving_metrics(registry: Registry) -> Registry:
+    """Declare the serving counters/gauges/histograms on ``registry``."""
+    for name, unit, help_ in SERVING_COUNTERS:
+        registry.counter(name, unit=unit, help=help_)
+    for name, unit, help_ in SERVING_GAUGES:
+        registry.gauge(name, unit=unit, help=help_)
+    for name, unit, help_ in SERVING_HISTOGRAMS:
+        registry.histogram(name, unit=unit, help=help_)
     return registry
 
 

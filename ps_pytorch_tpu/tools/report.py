@@ -265,6 +265,36 @@ def collect(repo: str):
             and all(r.get("ok") is True and r.get("bitwise_identical") is True
                     for r in wins),
             "errors": errors})
+    p = _newest("BENCH_SERVE_r[0-9]*.json", repo)
+    if p:
+        # Serving evidence (bench_suite serve_sequential_8/serve_batched_8 +
+        # derived serve_batch_win_8): ok means batched decode cleared the
+        # 1.5x aggregate-tokens/sec bar over sequential AND both runs
+        # sampled bitwise-identical tokens (slot-count invariance =
+        # generate() parity), with the p99 bars recorded alongside.
+        rows = _load(p)
+        if isinstance(rows, dict):
+            rows = [rows]
+        rows = [r for r in rows if isinstance(r, dict)]
+        errors = [r.get("config", r.get("_parse_error", "?")) for r in rows
+                  if "error" in r or "_parse_error" in r]
+        wins = [r for r in rows
+                if str(r.get("config", "")).startswith("serve_batch_win")]
+        head = max(wins, key=lambda r: r.get("ratio") or 0.0, default=None)
+        add("serving", p, {
+            "rows": len(rows),
+            "value": head.get("ratio") if head else None,
+            "unit": "x vs sequential (tokens/s)",
+            "ttft_p99_ms": head.get("ttft_p99_ms") if head else None,
+            "latency_p99_ms": head.get("latency_p99_ms") if head else None,
+            "platform": next((r.get("platform") for r in rows
+                              if r.get("platform")), "host"),
+            "ok": bool(wins) and not errors
+            and all(r.get("ok") is True and r.get("bitwise_identical") is True
+                    and r.get("ttft_p99_ms") is not None
+                    and r.get("latency_p99_ms") is not None
+                    for r in wins),
+            "errors": errors})
     p = os.path.join(repo, "COPYCHECK.json")
     if os.path.exists(p):
         d = as_dict(_load(p))

@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""Serve a ``train_lm.py`` checkpoint over HTTP with continuous batching.
+
+The serving counterpart of ``generate.py``: instead of one ad-hoc decode,
+stand up the full request lifecycle — bounded admission queue, slot-based
+continuously-batched decode (``ps_pytorch_tpu/serving/``), and hot reload
+of newer VALID checkpoints while requests stream (corrupt newest ones are
+walked past, same contract as training resume).
+
+    python train_lm.py --lm-corpus-file corpus.txt --train-dir ./lm ...
+    python serve.py --train-dir ./lm --serve-port 8300 --serve-slots 8
+    curl -s localhost:8300/v1/generate -d '{"prompt": "def train(", "n_new": 64}'
+
+Model geometry comes from the checkpoint's own config; the ``--serve-*``
+flags (config.py) size the engine. Byte-level LM: "prompt" is UTF-8 text;
+send "tokens" (int list) for non-byte vocabularies.
+"""
+
+import argparse
+import dataclasses
+import json
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    # The --serve-* surface lives in config.py with everything else (one
+    # dataclass, config-time validation); serve.py just consumes it.
+    from ps_pytorch_tpu.config import TrainConfig, add_train_args
+
+    p = add_train_args(argparse.ArgumentParser(description=__doc__))
+    ns = p.parse_args(argv)
+    try:
+        args = TrainConfig(**{f.name: getattr(ns, f.name)
+                              for f in dataclasses.fields(TrainConfig)})
+    except ValueError as e:
+        p.error(str(e))
+
+    from ps_pytorch_tpu.parallel.dist import _apply_platform_overrides
+    _apply_platform_overrides()
+
+    from ps_pytorch_tpu.models.transformer import migrate_packed_qkv
+    from ps_pytorch_tpu.runtime import checkpoint as ckpt
+    from ps_pytorch_tpu.runtime.lm_eval import (
+        build_lm_oracle, build_lm_template, lm_geometry,
+    )
+    from ps_pytorch_tpu.serving.engine import ServingEngine
+    from ps_pytorch_tpu.serving.reload import CheckpointWatcher
+    from ps_pytorch_tpu.serving.server import ServingFrontend
+    from ps_pytorch_tpu.telemetry.registry import (
+        Registry, declare_serving_metrics,
+    )
+
+    step = ckpt.latest_valid_step(args.train_dir)
+    if step is None:
+        p.error(f"no valid model_step_<k> checkpoints in {args.train_dir}")
+    with open(f"{ckpt.checkpoint_path(args.train_dir, step)}/config.json") as f:
+        cfg = TrainConfig.from_json(f.read())
+    if cfg.network != "TransformerLM":
+        # The engine's slot decode reuses Block.decode's fixed-length KV
+        # cache, which the MoE blocks don't implement.
+        p.error(f"serve.py decodes TransformerLM checkpoints; this one is "
+                f"{cfg.network} (use generate.py for one-shot MoE decode)")
+    template = build_lm_template(cfg)
+    _, to_tree = build_lm_oracle(cfg)
+    got = ckpt.load_latest_valid(args.train_dir, template,
+                                 migrate=migrate_packed_qkv)
+    if got is None:
+        p.error(f"no restorable checkpoint in {args.train_dir}")
+    state, _, _, step = got
+
+    geo = lm_geometry(cfg)
+    registry = Registry()
+    declare_serving_metrics(registry)
+    engine = ServingEngine(
+        to_tree(state.params), slots=args.serve_slots,
+        vocab=geo["vocab_size"], d_model=geo["d_model"],
+        n_layers=geo["n_layers"], n_heads=geo["n_heads"],
+        max_seq_len=geo["max_seq_len"], model_step=step, registry=registry)
+    watcher = None
+    if args.serve_reload_s > 0:
+        watcher = CheckpointWatcher(args.train_dir, template,
+                                    to_tree=to_tree,
+                                    migrate=migrate_packed_qkv,
+                                    start_step=step)
+    frontend = ServingFrontend(
+        engine, watcher=watcher, host=args.serve_host, port=args.serve_port,
+        max_queue=args.serve_max_queue, reload_s=args.serve_reload_s,
+        default_deadline_s=args.serve_deadline_s,
+        default_n_new=args.serve_max_new)
+    frontend.start()
+    print(json.dumps({"serving": f"http://{args.serve_host}:{frontend.port}",
+                      "model_step": step, "slots": args.serve_slots,
+                      "vocab": geo["vocab_size"],
+                      "seq_len": geo["max_seq_len"]}))
+    sys.stdout.flush()
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        frontend.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -107,3 +107,47 @@ def test_armor_bad_input_raises_like_stdlib():
         expected = str(e)
     with pytest.raises(ValueError, match=expected.split(":")[0]):
         b85decode(bad)
+
+
+# ---- int8 on the bucketed wire schedule (serving PR satellite) ----
+
+@pytest.mark.parametrize("bucket_bytes,workers",
+                         [(0, 0), (1024, 0), (1024, 4), (1, 4)])
+def test_int8_bucketed_schedule_bitwise_matches_whole_tree(bucket_bytes,
+                                                           workers):
+    """The aggregator's per-bucket int8 path must produce the EXACT payload
+    of the old whole-tree pass: the stochastic-rounding key is folded per
+    global leaf index, so bucket boundaries (and worker count) can never
+    change a single bit on the wire."""
+    import jax
+    import jax.numpy as jnp
+
+    from ps_pytorch_tpu.ops import quantize_int8
+    from ps_pytorch_tpu.parallel.async_dp import StaleGradientAggregator
+
+    rng = np.random.default_rng(0)
+    grads = {
+        "w": jnp.asarray(rng.normal(size=(64, 33)).astype(np.float32)),
+        "b": jnp.asarray(rng.normal(size=(257,)).astype(np.float32)),
+        "scale": jnp.asarray(np.float32(1.7)),
+        "emb": jnp.asarray(rng.normal(size=(16, 8, 4)).astype(np.float32)),
+    }
+    slice_id, step = 1, 13
+
+    leaves, _ = jax.tree.flatten(grads)
+    key = jax.random.key(hash((slice_id, step)) & 0x7FFFFFFF)
+    ref = [quantize_int8(l, jax.random.fold_in(key, i))
+           for i, l in enumerate(leaves)]
+
+    agg = StaleGradientAggregator(2, compress=True, codec="int8",
+                                  wire_bucket_bytes=bucket_bytes,
+                                  wire_workers=workers)
+    agg.submit(slice_id, step, grads)
+    _, got, _ = agg._pool[slice_id]
+
+    assert len(got) == len(ref)
+    for g, r in zip(got, ref):
+        np.testing.assert_array_equal(np.asarray(g.values),
+                                      np.asarray(r.values))
+        np.testing.assert_array_equal(np.asarray(g.scales),
+                                      np.asarray(r.scales))

@@ -533,3 +533,94 @@ def test_report_resilience_family(tmp_path):
     assert "resilience" in fams
     e = fams["resilience"]
     assert e["ok"] is True and e["crashes"] == 1 and e["kv_retries"] == 9
+
+
+# ---- leader lease (LeaderLost detection) ----
+
+def _lease_pair(clock, interval=1.0, kv=None, **follower_kw):
+    kv = kv if kv is not None else KVStore()
+    leader = Coordinator(4, mode="sync", kv=kv, leader=True,
+                         lease_interval_s=interval, clock=clock.time)
+    follower = Coordinator(4, mode="sync", kv=kv, leader=False,
+                           lease_interval_s=interval, clock=clock.time,
+                           **follower_kw)
+    return kv, leader, follower
+
+
+def test_leader_lease_stale_raises_leader_lost():
+    from ps_pytorch_tpu.runtime.coordinator import LeaderLost
+    clock = ManualClock()
+    kv, leader, follower = _lease_pair(clock)
+    leader.announce_step(1)
+    leader.participation_mask(1)           # publishes mask 1 + lease
+    np.testing.assert_array_equal(
+        follower.participation_mask(1, timeout_s=5.0), np.ones(4, np.float32))
+    # Leader dies: no refresh, clock sails past 3x interval. The follower's
+    # wait for step 2's (never-published) mask must fail as LeaderLost long
+    # before the run deadline, not as a TimeoutError at it.
+    clock.now += 10.0
+    with pytest.raises(LeaderLost, match="stale"):
+        follower.participation_mask(2, timeout_s=60.0)
+    assert follower.stats["leader_lost"] == 1
+
+
+def test_leader_lease_fresh_is_not_leader_lost():
+    # A slow leader (lease refreshed, mask late) stays a TimeoutError:
+    # the lease distinguishes dead-vs-slow, it must not misfire on slow.
+    clock = ManualClock()
+    kv, leader, follower = _lease_pair(clock)
+    leader.announce_step(1)
+    leader.participation_mask(1)
+    with pytest.raises(TimeoutError):
+        follower.participation_mask(2, timeout_s=0.3)
+    assert "leader_lost" not in follower.stats
+
+
+def test_leader_lease_bootstrap_grace_without_publish():
+    # No lease ever written (leader hasn't reached its first publish):
+    # followers fall back to the plain deadline instead of LeaderLost.
+    clock = ManualClock(start=50.0)
+    follower = Coordinator(4, mode="sync", kv=KVStore(), leader=False,
+                           lease_interval_s=1.0, clock=clock.time)
+    with pytest.raises(TimeoutError):
+        follower.participation_mask(1, timeout_s=0.3)
+    assert "leader_lost" not in follower.stats
+
+
+def test_leader_lease_refresh_throttled():
+    clock = ManualClock()
+    kv, leader, _ = _lease_pair(clock, interval=5.0)
+    for s in (1, 2, 3):
+        leader.announce_step(s)
+        leader.participation_mask(s)       # same clock tick: one write
+    assert json.loads(kv.get(f"{leader.run_id}/lease"))[0] == 1
+    clock.now += 6.0
+    leader.announce_step(4)
+    leader.participation_mask(4)
+    assert json.loads(kv.get(f"{leader.run_id}/lease"))[0] == 4
+
+
+def test_leader_lease_survives_kv_chaos_then_detects_death():
+    """Chaos acceptance: with injected KV drops on the follower's plane,
+    transient errors during lease reads are absorbed (counted, not fatal);
+    a genuinely stale lease still surfaces as LeaderLost."""
+    from ps_pytorch_tpu.runtime.coordinator import LeaderLost
+    clock = ManualClock()
+    base = KVStore()
+    inj = FaultInjector("kv_drop:p=0.5,seed=11", process_index=1)
+    kv_f = inj.wrap_kv(base)
+    leader = Coordinator(4, mode="sync", kv=base, leader=True,
+                         lease_interval_s=1.0, clock=clock.time)
+    follower = Coordinator(4, mode="sync", kv=kv_f, leader=False,
+                           lease_interval_s=1.0, clock=clock.time)
+    for s in (1, 2):
+        leader.announce_step(s)
+        leader.participation_mask(s)
+        np.testing.assert_array_equal(
+            follower.participation_mask(s, timeout_s=30.0),
+            np.ones(4, np.float32))
+    assert follower.stats.get("mask_wait_errors", 0) >= 0  # absorbed, never raised
+    clock.now += 10.0                       # leader silent past the timeout
+    with pytest.raises(LeaderLost):
+        follower.participation_mask(3, timeout_s=60.0)
+    assert inj.snapshot()["kv_drops"] > 0

@@ -1,0 +1,425 @@
+"""Serving subsystem (ps_pytorch_tpu/serving/).
+
+The load-bearing contract is PARITY: the continuous-batching engine must
+sample bit-identical tokens to one-shot ``models/generate.generate`` for
+the same request/seed at EVERY slot count and admission order — batching is
+an implementation detail a request can never observe. On top of that:
+admission-queue backpressure/shedding, hot checkpoint reload mid-stream
+(valid newer picked up, corrupt newest walked past), the stdlib HTTP
+front-end, the load generator, and the telemetry histogram the latency
+stats ride on.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ps_pytorch_tpu.models.generate import generate
+from ps_pytorch_tpu.models.transformer import TransformerLM
+from ps_pytorch_tpu.serving.engine import Request, ServingEngine, serve_loop
+from ps_pytorch_tpu.serving.loadgen import (
+    make_requests, run_closed_loop, run_open_loop, summarize,
+)
+from ps_pytorch_tpu.serving.queue import AdmissionQueue
+from ps_pytorch_tpu.serving.reload import CheckpointWatcher
+
+V, D, L, H, S = 61, 32, 2, 2, 96
+
+
+@pytest.fixture(scope="module")
+def params():
+    model = TransformerLM(vocab_size=V, d_model=D, n_layers=L, n_heads=H,
+                          max_seq_len=S)
+    return model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32),
+                      positions=jnp.arange(8))["params"]
+
+
+def _engine(params, slots, **kw):
+    return ServingEngine(params, slots=slots, vocab=V, d_model=D,
+                         n_layers=L, n_heads=H, max_seq_len=S, **kw)
+
+
+# Mixed shapes and sampling regimes: temp>0 with/without top_k, greedy,
+# and an n_new=1 request (completes at admission, never holds a slot).
+_SPECS = [
+    dict(n_new=7, temperature=0.8, top_k=7, seed=3, plen=5),
+    dict(n_new=15, temperature=0.0, top_k=0, seed=1, plen=12),
+    dict(n_new=1, temperature=1.3, top_k=5, seed=9, plen=3),
+    dict(n_new=10, temperature=0.5, top_k=0, seed=4, plen=8),
+    dict(n_new=4, temperature=0.9, top_k=11, seed=7, plen=20),
+]
+
+
+def _reqs_and_refs(params):
+    rng = np.random.default_rng(0)
+    reqs, refs = [], []
+    for s in _SPECS:
+        prompt = rng.integers(0, V, size=s["plen"]).astype(np.int32)
+        reqs.append(Request(prompt=prompt, n_new=s["n_new"],
+                            temperature=s["temperature"], top_k=s["top_k"],
+                            seed=s["seed"]))
+        out = generate(params, jnp.asarray(prompt[None]), n_new=s["n_new"],
+                       vocab=V, d_model=D, n_layers=L, n_heads=H,
+                       max_seq_len=S, temperature=s["temperature"],
+                       top_k=s["top_k"], seed=s["seed"])
+        refs.append(np.asarray(out[0])[s["plen"]:].tolist())
+    return reqs, refs
+
+
+@pytest.mark.parametrize("slots", [1, 2, 4])
+def test_engine_bitwise_parity_with_generate(params, slots):
+    reqs, refs = _reqs_and_refs(params)
+    eng = _engine(params, slots)
+    eng.run_to_completion(reqs)
+    for req, ref in zip(reqs, refs):
+        assert req.state == "done"
+        assert req.tokens == ref     # bit-identical, not approximately
+    assert eng.served == len(reqs)
+    assert eng.free_slots == slots
+
+
+def test_engine_parity_under_staggered_admission(params):
+    """Requests admitted mid-flight of others still sample their exact
+    generate() tokens — slot interleave is invisible to a request."""
+    reqs, refs = _reqs_and_refs(params)
+    eng = _engine(params, 2)
+    assert eng.admit(reqs[0]) and eng.admit(reqs[1])
+    for _ in range(3):
+        eng.step()
+    eng.run_to_completion(reqs[2:])
+    while eng.active_count:
+        eng.step()
+    assert [r.tokens for r in reqs] == refs
+
+
+def test_engine_validation_errors(params):
+    eng = _engine(params, 1)
+    bad = [
+        (Request(prompt=np.zeros(0, np.int32), n_new=4), "non-empty"),
+        (Request(prompt=np.ones(4, np.int32), n_new=0), "n_new"),
+        (Request(prompt=np.ones(4, np.int32), n_new=4, top_k=-1), "top_k"),
+        (Request(prompt=np.ones(4, np.int32), n_new=4, temperature=-0.5),
+         "temperature"),
+        (Request(prompt=np.asarray([V + 3], np.int32), n_new=4),
+         "vocabulary"),
+        (Request(prompt=np.ones(S, np.int32), n_new=4), "cache length"),
+    ]
+    for req, needle in bad:
+        with pytest.raises(ValueError, match=needle):
+            eng.admit(req)
+    assert eng.active_count == 0
+
+
+def test_engine_admit_false_when_full(params):
+    eng = _engine(params, 1)
+    a = Request(prompt=np.ones(4, np.int32), n_new=8)
+    b = Request(prompt=np.ones(4, np.int32), n_new=8)
+    assert eng.admit(a)
+    assert not eng.admit(b)          # no free slot; not an error
+    while eng.active_count:
+        eng.step()
+    assert eng.admit(b)
+
+
+def test_queue_backpressure_and_deadline_shed():
+    t = [0.0]
+    q = AdmissionQueue(2, clock=lambda: t[0])
+    r1 = Request(prompt=np.ones(2, np.int32), n_new=2)
+    r2 = Request(prompt=np.ones(2, np.int32), n_new=2, deadline_t=5.0)
+    r3 = Request(prompt=np.ones(2, np.int32), n_new=2)
+    assert q.submit(r1) and q.submit(r2)
+    assert not q.submit(r3)          # full -> immediate reject
+    assert r3.state == "rejected" and r3.wait(0)
+    assert q.rejected_full == 1
+    t[0] = 10.0                      # r2's deadline passes while queued
+    assert q.take() is r1
+    assert q.take() is None          # r2 shed on the way out, queue empty
+    assert r2.state == "shed" and q.shed_deadline == 1
+    assert q.depth() == 0
+
+
+def test_serve_loop_drains_queue(params):
+    eng = _engine(params, 2)
+    q = AdmissionQueue(8)
+    reqs = make_requests(5, prompt_len=6, n_new=5, vocab=V, seed=0)
+    for r in reqs:
+        q.submit(r)
+    stop = threading.Event()
+    thread = threading.Thread(target=serve_loop, args=(eng, q),
+                              kwargs=dict(reload_s=0.0, stop=stop),
+                              daemon=True)
+    thread.start()
+    try:
+        for r in reqs:
+            assert r.wait(60.0), "serve_loop did not resolve the request"
+            assert r.state == "done" and len(r.tokens) == 5
+    finally:
+        stop.set()
+        thread.join(timeout=10.0)
+
+
+# ---- hot reload ----
+
+def _lm_cfg(tmp_path):
+    from ps_pytorch_tpu.config import TrainConfig
+    return TrainConfig(network="TransformerLM", lm_vocab=V, lm_d_model=D,
+                       lm_layers=L, lm_heads=H, lm_seq_len=S,
+                       train_dir=str(tmp_path))
+
+
+def test_hot_reload_mid_stream_skips_corrupt_newest(params, tmp_path):
+    """Mid-stream reload: the watcher picks the newest VALID checkpoint
+    (corrupt newest walked past via load_latest_valid), the engine swaps
+    params between ticks, and the in-flight request still completes —
+    with its pre-reload prefix exactly matching the OLD params' decode."""
+    import os
+
+    from ps_pytorch_tpu.resilience.faults import corrupt_file
+    from ps_pytorch_tpu.runtime import checkpoint as ckpt
+    from ps_pytorch_tpu.runtime.lm_eval import build_lm_template
+
+    cfg = _lm_cfg(tmp_path)
+    template = build_lm_template(cfg)
+    state_a = template.replace(params=params)
+    ckpt.save_checkpoint(cfg.train_dir, 1, state_a,
+                         config_json=cfg.to_json())
+
+    eng = _engine(params, 2, model_step=1)
+    watcher = CheckpointWatcher(cfg.train_dir, template, start_step=1)
+    assert watcher.poll() is None    # nothing newer yet
+
+    prompt = np.arange(4, dtype=np.int32) % V
+    req = Request(prompt=prompt, n_new=20, temperature=0.7, top_k=9, seed=5)
+    eng.admit(req)
+    for _ in range(5):
+        eng.step()
+    prefix = list(req.tokens)        # sampled under params A
+
+    # Training commits step 3 (different params) and a CORRUPT step 5.
+    params_b = jax.tree.map(lambda a: a + 0.25, params)
+    ckpt.save_checkpoint(cfg.train_dir, 3, template.replace(params=params_b),
+                         config_json=cfg.to_json())
+    p5 = ckpt.save_checkpoint(cfg.train_dir, 5,
+                              template.replace(params=params_b),
+                              config_json=cfg.to_json())
+    corrupt_file(os.path.join(p5, "state.msgpack"), "flip")
+
+    got = watcher.poll()
+    assert got is not None and got.step == 3
+    assert watcher.skipped_corrupt >= 1
+    eng.set_params(got.params, step=got.step)
+    assert eng.model_step == 3
+
+    while eng.active_count:
+        eng.step()
+    assert req.state == "done" and len(req.tokens) == 20
+    assert req.tokens[:6] == prefix[:6]     # pre-reload prefix untouched
+
+    # The reference decode under pure params A: the post-reload suffix must
+    # DIFFER somewhere (params actually changed mid-stream).
+    ref = np.asarray(generate(
+        params, jnp.asarray(prompt[None]), n_new=20, vocab=V, d_model=D,
+        n_layers=L, n_heads=H, max_seq_len=S, temperature=0.7, top_k=9,
+        seed=5)[0])[len(prompt):].tolist()
+    assert ref[:len(prefix)] == prefix
+    assert watcher.poll() is None    # step 5 stays corrupt; no re-offer
+
+
+def test_watcher_all_corrupt_keeps_serving(params, tmp_path):
+    import os
+
+    from ps_pytorch_tpu.resilience.faults import corrupt_file
+    from ps_pytorch_tpu.runtime import checkpoint as ckpt
+    from ps_pytorch_tpu.runtime.lm_eval import build_lm_template
+
+    cfg = _lm_cfg(tmp_path)
+    template = build_lm_template(cfg)
+    p2 = ckpt.save_checkpoint(cfg.train_dir, 2,
+                              template.replace(params=params),
+                              config_json=cfg.to_json())
+    corrupt_file(os.path.join(p2, "state.msgpack"), "truncate")
+    watcher = CheckpointWatcher(cfg.train_dir, template, start_step=1)
+    assert watcher.poll() is None
+    assert watcher.skipped_corrupt == 1 and watcher.reloads == 0
+
+
+# ---- HTTP front-end ----
+
+def test_http_roundtrip(params):
+    from ps_pytorch_tpu.serving.server import ServingFrontend
+    from ps_pytorch_tpu.telemetry.registry import (
+        Registry, declare_serving_metrics,
+    )
+
+    registry = declare_serving_metrics(Registry())
+    eng = _engine(params, 2, model_step=7, registry=registry)
+    prompt = np.arange(5, dtype=np.int32).tolist()
+    ref = np.asarray(generate(
+        params, jnp.asarray(np.asarray(prompt, np.int32)[None]), n_new=6,
+        vocab=V, d_model=D, n_layers=L, n_heads=H, max_seq_len=S,
+        temperature=0.8, top_k=7, seed=2)[0])[5:].tolist()
+
+    with ServingFrontend(eng, port=0, max_queue=4) as fe:
+        url = f"http://127.0.0.1:{fe.port}"
+
+        def post(body, expect=200):
+            req = urllib.request.Request(
+                f"{url}/v1/generate", data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"})
+            try:
+                with urllib.request.urlopen(req, timeout=60) as resp:
+                    return resp.status, json.loads(resp.read())
+            except urllib.error.HTTPError as e:
+                return e.code, json.loads(e.read())
+
+        code, out = post({"tokens": prompt, "n_new": 6, "temperature": 0.8,
+                          "top_k": 7, "seed": 2})
+        assert code == 200
+        assert out["tokens"] == ref          # parity through the full stack
+        assert out["model_step"] == 7
+        assert out["ttft_ms"] >= 0 and out["latency_ms"] >= out["ttft_ms"]
+
+        code, out = post({"tokens": [1, 2], "n_new": 0})
+        assert code == 400 and "n_new" in out["error"]
+        code, out = post({"nonsense": 1})
+        assert code == 400
+
+        with urllib.request.urlopen(f"{url}/healthz", timeout=10) as resp:
+            health = json.loads(resp.read())
+        assert health["ok"] and health["model_step"] == 7
+        with urllib.request.urlopen(f"{url}/stats", timeout=10) as resp:
+            stats = json.loads(resp.read())
+        assert stats["served"] >= 1 and stats["slots"] == 2
+        assert stats["metrics"]["serve_requests"] >= 1
+        assert stats["metrics"]["serve_request_latency_s"]["count"] >= 1
+
+
+# ---- load generator ----
+
+def test_loadgen_closed_loop_stats(params):
+    eng = _engine(params, 4)
+    reqs = make_requests(6, prompt_len=8, n_new=6, vocab=V, seed=1)
+    stats = run_closed_loop(eng, reqs)
+    assert stats["completed"] == 6 and stats["tokens"] == 36
+    assert stats["tokens_per_sec"] > 0
+    for k in ("ttft_p50_ms", "ttft_p99_ms", "latency_p50_ms",
+              "latency_p99_ms"):
+        assert stats[k] >= 0
+    assert stats["ttft_p50_ms"] <= stats["latency_p99_ms"]
+
+
+def test_loadgen_deterministic_across_slot_counts(params):
+    tok = []
+    for slots in (1, 3):
+        eng = _engine(params, slots)
+        reqs = make_requests(4, prompt_len=6, n_new=8, vocab=V, seed=2)
+        run_closed_loop(eng, reqs)
+        tok.append([r.tokens for r in reqs])
+    assert tok[0] == tok[1]
+
+
+def test_summarize_counts_non_done_states():
+    done = Request(prompt=np.ones(2, np.int32), n_new=2)
+    done.state, done.tokens = "done", [1, 2]
+    done.t_submit, done.t_first, done.t_done = 0.0, 0.1, 0.2
+    shed = Request(prompt=np.ones(2, np.int32), n_new=2)
+    shed.state = "shed"
+    out = summarize([done, shed], wall_s=1.0)
+    assert out["completed"] == 1 and out["shed"] == 1
+    assert out["tokens"] == 2 and out["tokens_per_sec"] == 2.0
+
+
+@pytest.mark.slow
+def test_loadgen_open_loop_soak(params):
+    """Poisson arrivals through the queue + serve_loop thread: every
+    request resolves, latency stats materialize, shedding stays sane."""
+    eng = _engine(params, 4)
+    reqs = make_requests(12, prompt_len=6, n_new=8, vocab=V, seed=3)
+    stats = run_open_loop(eng, reqs, rate_rps=50.0, max_queue=16,
+                          deadline_s=60.0)
+    assert stats["completed"] + stats["shed"] + stats["rejected"] == 12
+    assert stats["completed"] >= 1
+    assert stats["failed"] == 0
+    assert stats["latency_p99_ms"] >= stats["latency_p50_ms"]
+
+
+# ---- satellite: generate() edge validation ----
+
+def test_generate_rejects_bad_n_new_and_top_k(params):
+    prompt = jnp.zeros((1, 4), jnp.int32)
+    kw = dict(vocab=V, d_model=D, n_layers=L, n_heads=H, max_seq_len=S)
+    with pytest.raises(ValueError, match="n_new"):
+        generate(params, prompt, n_new=0, **kw)
+    with pytest.raises(ValueError, match="top_k"):
+        generate(params, prompt, n_new=2, top_k=-3, **kw)
+
+
+def test_generate_cli_rejects_bad_n_new_and_top_k(tmp_path, capsys):
+    import generate as cli
+    for flags in (["--n-new", "0"], ["--top-k", "-1"]):
+        with pytest.raises(SystemExit):
+            cli.main(["--train-dir", str(tmp_path), "--prompt", "hi"]
+                     + flags)
+
+
+# ---- serve config knobs ----
+
+def test_serve_config_validation():
+    from ps_pytorch_tpu.config import TrainConfig
+    assert TrainConfig().serve_slots == 8
+    with pytest.raises(ValueError, match="serve_slots"):
+        TrainConfig(serve_slots=0)
+    with pytest.raises(ValueError, match="serve_max_queue"):
+        TrainConfig(serve_max_queue=0)
+    with pytest.raises(ValueError, match="serve_deadline_s"):
+        TrainConfig(serve_deadline_s=0.0)
+    with pytest.raises(ValueError, match="leader_lease_s"):
+        TrainConfig(leader_lease_s=-1.0)
+
+
+# ---- telemetry histogram ----
+
+def test_registry_histogram():
+    from ps_pytorch_tpu.telemetry.registry import Registry
+    reg = Registry()
+    reg.histogram("lat", unit="s", buckets=(0.1, 1.0, 10.0))
+    assert reg.hist_summary("lat")["count"] == 0
+    for v in (0.05, 0.2, 0.3, 0.5, 5.0):
+        reg.observe("lat", v)
+    s = reg.hist_summary("lat")
+    assert s["count"] == 5 and s["min"] == 0.05 and s["max"] == 5.0
+    assert abs(s["sum"] - 6.05) < 1e-9
+    assert 0.05 <= s["p50"] <= 1.0       # median falls in the (0.1, 1] bucket
+    assert 1.0 <= s["p99"] <= 5.0        # p99 lands in the top bucket
+    with pytest.raises(TypeError):
+        reg.inc("lat")                   # histogram is not a counter
+    with pytest.raises(KeyError):
+        reg.observe("nope", 1.0)
+    snap = reg.snapshot()
+    assert snap["lat"]["count"] == 5
+
+
+def test_registry_histogram_bad_buckets():
+    from ps_pytorch_tpu.telemetry.registry import Registry
+    with pytest.raises(ValueError, match="ascending"):
+        Registry().histogram("h", buckets=(1.0, 0.5))
+
+
+def test_declare_serving_metrics_idempotent():
+    from ps_pytorch_tpu.telemetry.registry import (
+        Registry, declare_serving_metrics,
+    )
+    reg = declare_serving_metrics(Registry())
+    declare_serving_metrics(reg)         # re-declare identical: fine
+    reg.inc("serve_tokens", 3)
+    reg.set("serve_active_slots", 2)
+    reg.observe("serve_ttft_s", 0.01)
+    snap = reg.snapshot()
+    assert snap["serve_tokens"] == 3.0
+    assert snap["serve_ttft_s"]["count"] == 1

@@ -254,7 +254,7 @@ def test_registry_typed_metrics():
         r.gauge("steps")            # re-declare as a different kind
     assert r.snapshot() == {"steps": 3.0, "lr": 0.01}
     with pytest.raises(ValueError):
-        MetricSpec("x", "histogram")
+        MetricSpec("x", "summary")      # histogram IS valid now; summary isn't
 
 
 # ---- aggregate.py: cross-host KV aggregation ----
