@@ -741,6 +741,76 @@ def bench_wire(name, steps, *, payload_mb=64, leaf_kb=1024, codec="blosc",
     return row
 
 
+def bench_ops_overhead(name, steps, *, batch=256, reps=3):
+    """Ops-plane cost row: the SAME jitted LeNet step loop timed bare and
+    with the full live-ops work per step — running /metrics exporter,
+    registry gauge/counter/histogram updates, health-watchdog observation,
+    and a flight-recorder step record. Both loops materialize the loss
+    (the sync the real trainers pay anyway), so overhead_frac isolates
+    exactly what the ops plane adds. min-of-reps on both sides trims
+    scheduler noise; the budget asserted in the row (and enforced by
+    tools/regress.py) is <2%."""
+    import tempfile
+
+    from ps_pytorch_tpu.telemetry import (
+        FlightRecorder, HealthMonitor, MetricsExporter, Registry,
+        declare_training_metrics, host_rss_bytes,
+    )
+
+    state0, step_fn, x, y, mask = _build("LeNet", "synthetic_mnist", batch,
+                                         n_devices=1)
+
+    def run(ops) -> float:
+        # The jitted step donates its input buffers; each rep needs a
+        # fresh copy of the initial state or the second rep reads
+        # deleted buffers.
+        state = jax.tree.map(jnp.copy, state0)
+        registry = declare_training_metrics(Registry())
+        health = HealthMonitor("nonfinite:warn;spike:warn;divergence:warn",
+                               registry=registry)
+        tmp = tempfile.mkdtemp(prefix="bench_ops_")
+        flightrec = FlightRecorder(os.path.join(tmp, "flightrec.json"),
+                                   registry=registry)
+        exporter = MetricsExporter(registry).start() if ops else None
+        try:
+            for i in range(3):
+                state, metrics = step_fn(state, x, y, mask,
+                                         jax.random.key(i))
+            jax.block_until_ready(state.params)
+            t0 = time.perf_counter()
+            prev = None
+            for i in range(steps):
+                state, metrics = step_fn(state, x, y, mask,
+                                         jax.random.key(100 + i))
+                loss = float(metrics["loss"])
+                if ops:
+                    registry.inc("train_steps")
+                    registry.set("train_step", float(i + 1))
+                    registry.set("train_loss", loss)
+                    t_step = time.perf_counter() - (prev or t0)
+                    registry.set("train_step_time_s", t_step)
+                    registry.observe("train_step_latency_s", t_step)
+                    registry.set("host_rss_bytes", float(host_rss_bytes()))
+                    flightrec.record_step(i + 1, loss=loss,
+                                          step_time=t_step)
+                    health.observe_step(i + 1, loss=loss, nonfinite=False,
+                                        step_time=t_step)
+                prev = time.perf_counter()
+            jax.block_until_ready(state.params)
+            return time.perf_counter() - t0
+        finally:
+            if exporter is not None:
+                exporter.stop()
+
+    baseline_s = min(run(False) for _ in range(reps))
+    ops_s = min(run(True) for _ in range(reps))
+    frac = (ops_s - baseline_s) / baseline_s
+    return {"config": name, "platform": jax.devices()[0].platform,
+            "steps": steps, "reps": reps, "global_batch": batch,
+            "baseline_s": round(baseline_s, 5), "ops_s": round(ops_s, 5),
+            "overhead_frac": round(frac, 5), "ok": frac < 0.02}
+
+
 CONFIGS = {
     "lenet_mnist_single": lambda steps: bench_throughput(
         "lenet_mnist_single", "LeNet", "synthetic_mnist", 128, steps,
@@ -865,6 +935,11 @@ CONFIGS = {
         "serve_sequential_8", steps, slots=1),
     "serve_batched_8": lambda steps: bench_serving(
         "serve_batched_8", steps, slots=8),
+    # -- live ops plane (ISSUE 6): exporter + watchdogs + flight recorder
+    # cost on the bare step loop; the row asserts the <2% budget that
+    # tools/regress.py's ops family gates. --
+    "ops_overhead": lambda steps: bench_ops_overhead(
+        "ops_overhead", max(steps, 30)),
 }
 
 

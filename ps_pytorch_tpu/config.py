@@ -138,6 +138,11 @@ class TrainConfig:
     trace_file: str = ""            # host-side Chrome trace_event JSON ("" = off; telemetry/trace.py, opens in Perfetto)
     timeline_file: str = ""         # leader-merged per-replica step timeline JSONL ("" = <metrics_file>.timeline when multi-process; telemetry/aggregate.py)
 
+    # -- live ops plane (telemetry/prometheus.py, health.py, flightrec.py) --
+    metrics_port: int = 0           # Prometheus /metrics + /healthz exporter port; 0 = off (multi-process runs bind port + process_index)
+    health_spec: str = ""           # training-health watchdogs, e.g. "nonfinite:halt;spike:warn,factor=10;stall:warn" (telemetry/health.py grammar)
+    flight_file: str = ""           # flight-recorder dump path ("" = <train_dir>/flightrec.json when health_spec or metrics_port is set)
+
     def __post_init__(self) -> None:
         if self.num_classes == 0:
             # Single source of truth for per-dataset class counts
@@ -179,6 +184,14 @@ class TrainConfig:
             # mid-run when the fault would have fired.
             from ps_pytorch_tpu.resilience.faults import parse_fault_spec
             parse_fault_spec(self.fault_spec)
+        if self.health_spec:
+            # Same config-time discipline as fault_spec: a typo'd watchdog
+            # must fail here, not during the incident it was meant to catch.
+            from ps_pytorch_tpu.telemetry.health import parse_health_spec
+            parse_health_spec(self.health_spec)
+        if self.metrics_port < 0:
+            raise ValueError(f"metrics_port={self.metrics_port} "
+                             "(must be >= 0; 0 = exporter off)")
         if self.kv_retry_attempts < 1:
             raise ValueError(f"kv_retry_attempts={self.kv_retry_attempts} "
                              "(must be >= 1; 1 = no retries)")

@@ -136,6 +136,18 @@ def masked_metrics(loss, acc, m, denom, msum):
     }
 
 
+def health_metrics(metrics, gnorm):
+    """Fold the watchdog signals into the step metrics: the global grad
+    norm and a nonfinite flag over norm+loss. Computed from values the
+    step already materializes — no extra collectives, no extra sync
+    (telemetry/health.py reads them at the trainer's existing 1-deep
+    pipeline sync point)."""
+    metrics["grad_norm"] = gnorm
+    metrics["nonfinite"] = 1.0 - jnp.isfinite(
+        gnorm + metrics["loss"]).astype(jnp.float32)
+    return metrics
+
+
 def place_state(mesh: Mesh, state: TrainState, specs=None) -> TrainState:
     """Host-local (numpy) TrainState -> correctly placed global arrays.
 
@@ -162,7 +174,7 @@ def fetch_replicated(mesh: Mesh, state: TrainState) -> TrainState:
 def make_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
                     state: TrainState, *, sync_batchnorm: bool = False,
                     remat: bool = False, donate: bool = True,
-                    input_norm=None) -> Callable:
+                    input_norm=None, skip_nonfinite: bool = False) -> Callable:
     """Build the jitted SPMD train step.
 
     Returns ``step_fn(state, x, y, mask, rng) -> (state, metrics)`` where
@@ -170,7 +182,13 @@ def make_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
       y: [B] int labels,
       mask: [n_data] float participation vector (K-of-N; all-ones = sync mode),
       rng: scalar PRNG key (per-replica dropout keys are folded in-graph).
-    metrics: dict of replicated scalars (loss, accuracy, participating).
+    metrics: dict of replicated scalars (loss, accuracy, participating,
+    grad_norm, nonfinite).
+
+    ``skip_nonfinite`` (the health plane's skip-step action) additionally
+    gates the update on ``isfinite(grad_norm)``: a NaN/Inf step leaves
+    params and optimizer state untouched — in-graph, so the poison never
+    reaches the weights even before the host notices.
     """
     has_bn = bool(jax.tree.leaves(state.batch_stats))
     loss_fn = make_loss_fn(model, has_bn, input_norm)
@@ -189,12 +207,19 @@ def make_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
         denom = jnp.maximum(msum, 1.0)
         gavg = jax.tree.map(
             lambda g: jax.lax.psum(g * m, "data") / denom, grads)
+        # Global gradient norm over the averaged (post-psum) tree: every
+        # replica computes the identical scalar, so it doubles as the
+        # health plane's NaN/Inf sentinel at zero extra collectives.
+        gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g))
+                             for g in jax.tree.leaves(gavg)))
         new_params, new_opt = apply_optimizer(
             tx, state.params, state.opt_state, gavg)
         # An all-zero mask must be a true no-op: the reference master never
         # steps without K gradients (sync_replicas_master_nn.py:179,204-208);
         # without this guard momentum decay/step counters would still move.
         stepped = msum > 0
+        if skip_nonfinite:
+            stepped = jnp.logical_and(stepped, jnp.isfinite(gnorm))
         new_params = jax.tree.map(
             lambda new, old: jnp.where(stepped, new, old), new_params, state.params)
         new_opt = jax.tree.map(
@@ -204,7 +229,8 @@ def make_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
             # the synced stats (same discipline as the gradient path).
             new_bs = jax.tree.map(
                 lambda a: jax.lax.psum(a * m, "data") / denom, new_bs)
-        metrics = masked_metrics(loss, acc, m, denom, msum)
+        metrics = health_metrics(masked_metrics(loss, acc, m, denom, msum),
+                                 gnorm)
         new_state = state.replace(
             step=state.step + 1, params=new_params, opt_state=new_opt,
             batch_stats=jax.tree.map(lambda a: a[None], new_bs))

@@ -123,7 +123,13 @@ class KVPytreeChannel:
 
     # ---- writer side ----
     def publish(self, version: int, tree: Any, meta: Optional[dict] = None) -> None:
-        with _span("wire_publish", channel=self.prefix, version=version):
+        # corr travels in BOTH the publish span's args and the wire meta:
+        # the reader copies it from meta into its wire_read span, and the
+        # stitcher (analyze.py stitch) joins the two sides of the merged
+        # Chrome trace into flow arrows on that shared id.
+        corr = f"{self.prefix}@{version}"
+        with _span("wire_publish", channel=self.prefix, version=version,
+                   corr=corr):
             leaves, treedef = jax.tree.flatten(tree)
             if treedef != self.treedef:
                 raise ValueError("published tree structure != channel template")
@@ -134,7 +140,7 @@ class KVPytreeChannel:
             self.publishes += 1
             self.kv.set(f"{self.prefix}/{version}/meta",
                         json.dumps({**(meta or {}), "chunks": chunk_counts,
-                                    **extra}))
+                                    "corr": corr, **extra}))
             # Pointer moves only after the payload is fully visible —
             # in the bucketed schedule that means after the LAST bucket's
             # worker has committed its chunks.
@@ -164,13 +170,14 @@ class KVPytreeChannel:
         pool = self._executor() if (self.workers > 1 and len(bks) > 1) else None
 
         def encode_put(b, block):
+            bcorr = f"{self.prefix}@{version}/b{b.index}"
             with _span("wire_encode", channel=self.prefix, bucket=b.index,
                        leaves=len(block)):
                 texts = [_encode_leaf(l, self.level, self.codec)
                          for l in block]
             nbytes = sum(len(c) for chunks in texts for c in chunks)
             with _span("wire_put", channel=self.prefix, bucket=b.index,
-                       bytes=nbytes):
+                       bytes=nbytes, corr=bcorr):
                 for off, chunks in enumerate(texts):
                     l_idx = b.start + off
                     for c_idx, c in enumerate(chunks):
@@ -219,14 +226,22 @@ class KVPytreeChannel:
         GC'd (or a transient KV failure this poll — see reader-side note).
         Reading the pointer's current target is race-free (see module
         docstring)."""
-        with _span("wire_read", channel=self.prefix):
+        with _span("wire_read", channel=self.prefix) as sargs:
             try:
-                return self._read(version)
+                got = self._read(version)
             except Exception as e:
                 if not is_retryable(e):
                     raise
                 self.read_errors += 1
                 return None
+            if got is not None and sargs is not None:
+                # Adopt the writer's correlation id so the merged Chrome
+                # trace can draw a flow arrow publish -> this read.
+                v, _, meta = got
+                sargs["version"] = v
+                if "corr" in meta:
+                    sargs["corr"] = meta["corr"]
+            return got
 
     def _read(self, version: Optional[int]) -> Optional[Tuple[int, Any, dict]]:
         if version is None:
@@ -269,7 +284,8 @@ class KVPytreeChannel:
 
         def get_decode(b_idx: int, start: int, n_leaves: int):
             with _span("wire_decode", channel=self.prefix, bucket=b_idx,
-                       leaves=n_leaves):
+                       leaves=n_leaves,
+                       corr=f"{self.prefix}@{version}/b{b_idx}"):
                 leaves, nbytes = [], 0
                 for l_idx in range(start, start + n_leaves):
                     chunks = [

@@ -36,8 +36,8 @@ from jax.flatten_util import ravel_pytree
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ps_pytorch_tpu.parallel.dp import (
-    TrainState, _model_collections, apply_optimizer, make_loss_fn,
-    masked_metrics,
+    TrainState, _model_collections, apply_optimizer, health_metrics,
+    make_loss_fn, masked_metrics,
 )
 
 
@@ -88,9 +88,11 @@ def zero_state_specs(state: TrainState) -> TrainState:
 def make_zero_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
                          state: TrainState, *, sync_batchnorm: bool = False,
                          remat: bool = False, donate: bool = True,
-                         input_norm=None) -> Callable:
-    """Same signature/semantics as ``dp.make_train_step`` with the weight
-    update sharded across the 'data' axis."""
+                         input_norm=None,
+                         skip_nonfinite: bool = False) -> Callable:
+    """Same signature/semantics as ``dp.make_train_step`` (including the
+    grad_norm/nonfinite health metrics and the ``skip_nonfinite`` gate)
+    with the weight update sharded across the 'data' axis."""
     has_bn = bool(jax.tree.leaves(state.batch_stats))
     n = mesh.shape["data"]
     loss_fn = make_loss_fn(model, has_bn, input_norm)
@@ -113,6 +115,10 @@ def make_zero_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
         chunk = -(-size // n)
         gflat = jnp.pad(gflat * m, (0, chunk * n - size))
         gshard = jax.lax.psum_scatter(gflat, "data", tiled=True) / denom
+        # Global grad norm from the scattered shards (padding is zeros, so
+        # it contributes nothing): one extra scalar psum, identical on
+        # every replica — the same watchdog sentinel dp.py computes.
+        gnorm = jnp.sqrt(jax.lax.psum(jnp.sum(jnp.square(gshard)), "data"))
 
         # This replica's parameter slice.
         _, pflat, unravel = _flat_size_and_unravel(state.params)
@@ -125,6 +131,8 @@ def make_zero_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
         new_pshard, new_opt = apply_optimizer(tx, pshard, opt_local, gshard)
 
         stepped = msum > 0
+        if skip_nonfinite:
+            stepped = jnp.logical_and(stepped, jnp.isfinite(gnorm))
         new_pshard = jnp.where(stepped, new_pshard, pshard)
         new_opt = jax.tree.map(
             lambda new, old: jnp.where(stepped, new, old), new_opt, opt_local)
@@ -136,7 +144,8 @@ def make_zero_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
         if has_bn and sync_batchnorm:
             new_bs = jax.tree.map(
                 lambda a: jax.lax.psum(a * m, "data") / denom, new_bs)
-        metrics = masked_metrics(loss, acc, m, denom, msum)
+        metrics = health_metrics(masked_metrics(loss, acc, m, denom, msum),
+                                 gnorm)
         new_state = state.replace(
             step=state.step + 1, params=new_params,
             opt_state=jax.tree.map(

@@ -25,6 +25,13 @@ Spec grammar (``;``-separated faults, each ``kind:key=val,key=val``):
         atomic rename (truncate: state.msgpack halved; flip: one byte
         XORed) — the torn/bit-rotted artifact the manifest verification
         must catch. Fires once.
+    grad_nan:step=30[,r=0]
+        Process ``r``'s participation mask is poisoned with NaN at step
+        ``step`` (once): the NaN rides the existing psums into loss /
+        grad-average / grad-norm — exactly what a fp overflow or a bad
+        lossy codec produces — WITHOUT a recompile (the mask is already a
+        float input). The health watchdogs (telemetry/health.py) are what
+        must catch it.
 
 Drop/delay decisions come from ``numpy.default_rng(seed + 10007 * pid)``:
 reproducible per process, uncorrelated across processes.
@@ -35,7 +42,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
-_KINDS = ("kv_drop", "kv_delay", "replica_crash", "ckpt_corrupt")
+_KINDS = ("kv_drop", "kv_delay", "replica_crash", "ckpt_corrupt", "grad_nan")
 _KV_OPS = ("set", "get", "delete")
 
 
@@ -130,6 +137,10 @@ def _validate(p: Dict[str, Any], part: str) -> None:
         if p.setdefault("mode", "flip") not in ("flip", "truncate"):
             raise ValueError(f"ckpt_corrupt mode must be flip|truncate "
                              f"(got {part!r})")
+    elif kind == "grad_nan":
+        if not isinstance(p.get("step"), int):
+            raise ValueError(f"grad_nan needs step=<int> (got {part!r})")
+        p.setdefault("r", 0)
 
 
 class FaultyKV:
@@ -198,7 +209,7 @@ class FaultInjector:
         self._fired = set()
         self.counters: Dict[str, int] = {
             "kv_drops": 0, "kv_delays": 0, "crashes": 0,
-            "ckpt_corruptions": 0}
+            "ckpt_corruptions": 0, "grad_nans": 0}
 
     # ---- KV plane ----
     @property
@@ -224,6 +235,20 @@ class FaultInjector:
                 self.counters["crashes"] += 1
                 raise InjectedCrash(
                     f"injected replica_crash r={f['r']} at step {step}")
+
+    def maybe_poison(self, step: int) -> bool:
+        """True when a grad_nan fault matches this process and step (once):
+        the trainer multiplies its participation mask by NaN before
+        dispatch, so the poisoned value flows through the jitted step's
+        psums like a genuine numeric blow-up."""
+        for i, f in enumerate(self.faults):
+            if f["kind"] != "grad_nan" or ("nan", i) in self._fired:
+                continue
+            if f["r"] == self.process_index and step >= f["step"]:
+                self._fired.add(("nan", i))
+                self.counters["grad_nans"] += 1
+                return True
+        return False
 
     # ---- checkpoint plane ----
     def after_checkpoint(self, train_dir: str, step: int) -> None:

@@ -43,7 +43,10 @@ from ps_pytorch_tpu.runtime import checkpoint as ckpt
 from ps_pytorch_tpu.runtime.coordinator import DistributedKV, KVStore
 from ps_pytorch_tpu.runtime.metrics import MetricsLogger
 from ps_pytorch_tpu.runtime.multislice import make_slice_grad_fn
-from ps_pytorch_tpu.telemetry import Tracer, set_default_tracer
+from ps_pytorch_tpu.telemetry import (
+    MetricsExporter, Registry, Tracer, declare_training_metrics,
+    device_memory_record, host_rss_bytes, set_default_tracer,
+)
 
 
 class AsyncTrainer:
@@ -150,6 +153,19 @@ class AsyncTrainer:
         # process's DCN legs cost relative to its compute.
         self.tracer = Tracer(pid=self.pid)
         self._prev_tracer = set_default_tracer(self.tracer)
+        # Live ops plane (lighter than the sync trainers: gauges + step
+        # counter, no watchdogs — the async loop has no global loss on
+        # followers to guard). Port is offset by process index so every
+        # worker of a local multi-process run gets its own endpoint.
+        self.registry = declare_training_metrics(Registry())
+        self.exporter = None
+        if cfg.metrics_port > 0:
+            self.exporter = MetricsExporter(
+                self.registry, port=cfg.metrics_port + self.pid,
+                health_fn=lambda: {"ok": True, "process_index": self.pid,
+                                   "version": self.version,
+                                   "role": "leader" if self.leader
+                                   else "follower"}).start()
         self.last_publish_s = 0.0
         self.version = 0        # canonical PS step (leader-owned)
         self.applied = 0
@@ -335,6 +351,8 @@ class AsyncTrainer:
         finally:
             # Sinks close on any exit (a follower TimeoutError must not
             # leak the JSONL handle or drop the trace).
+            if self.exporter is not None:
+                self.exporter.stop()
             self.metrics.close()
             if cfg.trace_file:
                 path = cfg.trace_file
@@ -373,7 +391,19 @@ class AsyncTrainer:
             own_steps += 1
             used = self._leader_apply() if self.leader else 0
             step_for_log = self.version if self.leader else own_steps
+            self.registry.inc("train_steps")
+            self.registry.observe("train_step_latency_s",
+                                  time.monotonic() - t0)
             if step_for_log and step_for_log % cfg.log_every == 0:
+                self.registry.set("train_step", float(step_for_log))
+                self.registry.set("train_loss", float(m["loss"]))
+                self.registry.set("train_step_time_s",
+                                  time.monotonic() - t0)
+                self.registry.set("host_rss_bytes", float(host_rss_bytes()))
+                mem = device_memory_record()
+                for k in ("device_mem_peak_bytes", "device_mem_bytes"):
+                    if k in mem:
+                        self.registry.set(k, float(mem[k]))
                 wire = self.transport.wire_stats()
                 extra = {}
                 if self.injector is not None:

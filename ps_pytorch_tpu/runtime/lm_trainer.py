@@ -20,6 +20,7 @@ just library code.
 """
 
 import json
+import os
 import time
 from typing import Optional
 
@@ -43,8 +44,9 @@ from ps_pytorch_tpu.parallel.sp import (
 from ps_pytorch_tpu.runtime import checkpoint as ckpt
 from ps_pytorch_tpu.runtime.metrics import MetricsLogger
 from ps_pytorch_tpu.telemetry import (
-    Tracer, aggregate_peak_flops, derive_step_record, set_default_tracer,
-    step_flops_of,
+    FlightRecorder, HealthMonitor, MetricsExporter, Registry, Tracer,
+    aggregate_peak_flops, declare_training_metrics, derive_step_record,
+    device_memory_record, host_rss_bytes, set_default_tracer, step_flops_of,
 )
 
 
@@ -192,6 +194,62 @@ class LMTrainer:
         if cfg.fault_spec:
             self.injector = resilience.FaultInjector(
                 cfg.fault_spec, process_index=jax.process_index())
+        # Live ops plane, same surfaces as the CNN Trainer. The LM step
+        # metrics carry loss only (no in-graph grad norm yet), so the
+        # watchdogs see loss at log cadence plus wall-clock stall.
+        self.registry = declare_training_metrics(Registry())
+        self.health: Optional[HealthMonitor] = None
+        if cfg.health_spec:
+            self.health = HealthMonitor(cfg.health_spec,
+                                        registry=self.registry)
+        self.flightrec: Optional[FlightRecorder] = None
+        flight_path = cfg.flight_file or (
+            os.path.join(cfg.train_dir, "flightrec.json")
+            if (cfg.health_spec or cfg.metrics_port > 0) else "")
+        if flight_path:
+            if jax.process_index() > 0:
+                flight_path = f"{flight_path}.p{jax.process_index()}"
+            self.flightrec = FlightRecorder(flight_path, tracer=self.tracer,
+                                            registry=self.registry)
+        self.exporter: Optional[MetricsExporter] = None
+        if cfg.metrics_port > 0:
+            self.exporter = MetricsExporter(
+                self.registry,
+                port=cfg.metrics_port + jax.process_index(),
+                health_fn=self._health_status).start()
+
+    def _health_status(self) -> dict:
+        body = self.health.status() if self.health is not None else {"ok": True}
+        body["process_index"] = jax.process_index()
+        return body
+
+    def _ops_step(self, step: int, *, loss=None, step_time=None,
+                  data_time=None) -> None:
+        r = self.registry
+        r.inc("train_steps")
+        r.set("train_step", step)
+        if loss is not None:
+            r.set("train_loss", loss)
+        if step_time is not None and step_time > 0:
+            r.set("train_step_time_s", step_time)
+            r.observe("train_step_latency_s", step_time)
+            r.set("train_examples_per_sec", self.cfg.batch_size / step_time)
+        if data_time is not None:
+            r.set("train_data_time_s", data_time)
+        mem = device_memory_record()
+        if mem:
+            r.set("device_mem_peak_bytes", mem.get("device_mem_peak_bytes", 0))
+            r.set("device_mem_bytes", mem.get("device_mem_bytes", 0))
+        r.set("host_rss_bytes", host_rss_bytes())
+        if self.flightrec is not None:
+            self.flightrec.record_step(step, loss=loss, step_time=step_time,
+                                       data_time=data_time)
+        if self.health is not None:
+            for ev in self.health.observe_step(step, loss=loss,
+                                               step_time=step_time):
+                if self.flightrec is not None:
+                    self.flightrec.record_health(ev)
+                print(f"HEALTH {ev.detector} ({ev.action}): {ev.message}")
 
     # ---- checkpoint/resume (same on-disk contract as the CNN Trainer) ----
     def _checkpoint(self, step: int) -> None:
@@ -279,6 +337,7 @@ class LMTrainer:
         if cfg.resume:
             self.maybe_resume()
         step = self.start_step
+        halted = False
         try:
             while step < cfg.max_steps:
                 step += 1
@@ -304,6 +363,7 @@ class LMTrainer:
                 # costs. The metrics_sync below (loss materialization) is
                 # deliberately NOT folded in, matching trainer.py.
                 t_step = time.monotonic() - t0
+                loss = None
                 if step % cfg.log_every == 0 or step == cfg.max_steps:
                     with self.tracer.span("metrics_sync", step=step):
                         loss = float(m["loss"])
@@ -321,14 +381,34 @@ class LMTrainer:
                         loss=loss, acc=0.0, participating=1.0,
                         step_time=t_step, data_time=t_data,
                         phases=self.tracer.step_summary(step), **derived)
+                self._ops_step(step, loss=loss, step_time=t_step,
+                               data_time=t_data)
+                if self.health is not None and self.health.should_halt:
+                    with self.tracer.span("checkpoint", step=step):
+                        self._checkpoint(step)
+                    if self.flightrec is not None:
+                        self.flightrec.dump(
+                            f"watchdog:{self.health.halt_event.detector}")
+                    print(f"HEALTH halt at step {step}: "
+                          f"{self.health.halt_event.message}")
+                    halted = True
+                    break
                 if cfg.eval_freq > 0 and step % cfg.eval_freq == 0:
                     with self.tracer.span("checkpoint", step=step):
                         self._checkpoint(step)
             jax.block_until_ready(self.state.params)
-            if cfg.eval_freq > 0 and step % cfg.eval_freq != 0:
+            if not halted and cfg.eval_freq > 0 and step % cfg.eval_freq != 0:
                 with self.tracer.span("checkpoint", step=step):
                     self._checkpoint(step)
+        except BaseException as e:
+            if self.flightrec is not None:
+                self.flightrec.record_event(
+                    "exception", {"type": type(e).__name__, "message": str(e)})
+                self.flightrec.dump(f"crash:{type(e).__name__}")
+            raise
         finally:
+            if self.exporter is not None:
+                self.exporter.stop()
             self.metrics.close()
             if cfg.trace_file:
                 path = cfg.trace_file

@@ -12,6 +12,7 @@ checkpoint. The Coordinator supplies the per-step participation mask
 (backup-worker/deadline policies) and step control.
 """
 
+import os
 import time
 from typing import Optional
 
@@ -36,8 +37,10 @@ from ps_pytorch_tpu.runtime import checkpoint as ckpt
 from ps_pytorch_tpu.runtime.coordinator import Coordinator
 from ps_pytorch_tpu.runtime.metrics import MetricsLogger
 from ps_pytorch_tpu.telemetry import (
-    TelemetryAggregator, Tracer, aggregate_peak_flops, derive_step_record,
-    set_default_tracer, step_flops_of,
+    FlightRecorder, HealthMonitor, MetricsExporter, Registry,
+    TelemetryAggregator, Tracer, aggregate_peak_flops,
+    declare_training_metrics, derive_step_record, device_memory_record,
+    host_rss_bytes, set_default_tracer, step_flops_of,
 )
 
 from ps_pytorch_tpu.data.datasets import sample_shape
@@ -59,6 +62,15 @@ class Trainer:
         sample = (1,) + sample_shape(cfg.dataset)
         from ps_pytorch_tpu.data.augment import input_norm_for
         input_norm = input_norm_for(cfg)
+        # Live ops plane: registry + watchdogs exist BEFORE the step builds,
+        # because the nonfinite skip action is an in-graph gate
+        # (make_train_step's skip_nonfinite) decided by the health spec.
+        self.registry = declare_training_metrics(Registry())
+        self.health: Optional[HealthMonitor] = None
+        if cfg.health_spec:
+            self.health = HealthMonitor(cfg.health_spec,
+                                        registry=self.registry)
+        skip_nonfinite = self.health.skip_nonfinite if self.health else False
         if cfg.shard_update:
             from ps_pytorch_tpu.parallel.zero import (
                 create_zero_train_state, make_zero_train_step, zero_state_specs,
@@ -68,7 +80,8 @@ class Trainer:
             self.step_fn = make_zero_train_step(
                 self.model, self.tx, self.mesh, self.state,
                 sync_batchnorm=cfg.sync_batchnorm, remat=cfg.remat,
-                donate=cfg.donate, input_norm=input_norm)
+                donate=cfg.donate, input_norm=input_norm,
+                skip_nonfinite=skip_nonfinite)
             self._state_specs = zero_state_specs
         else:
             self.state = create_train_state(self.model, self.tx, self.mesh,
@@ -77,7 +90,8 @@ class Trainer:
                                            self.state,
                                            sync_batchnorm=cfg.sync_batchnorm,
                                            remat=cfg.remat, donate=cfg.donate,
-                                           input_norm=input_norm)
+                                           input_norm=input_norm,
+                                           skip_nonfinite=skip_nonfinite)
             from ps_pytorch_tpu.parallel.dp import state_specs
             self._state_specs = state_specs
         self.eval_fn = make_eval_step(self.model, input_norm)
@@ -141,6 +155,26 @@ class Trainer:
         # The previous default is restored when train() exits so a trainer
         # never leaks its tracer into unrelated code running afterwards.
         self._prev_tracer = set_default_tracer(self.tracer)
+        # Flight recorder: armed whenever any ops-plane surface is on; its
+        # rings cost O(capacity) and only dump() touches the disk.
+        self.flightrec: Optional[FlightRecorder] = None
+        flight_path = cfg.flight_file or (
+            os.path.join(cfg.train_dir, "flightrec.json")
+            if (cfg.health_spec or cfg.metrics_port > 0) else "")
+        if flight_path:
+            if jax.process_index() > 0:
+                flight_path = f"{flight_path}.p{jax.process_index()}"
+            self.flightrec = FlightRecorder(flight_path, tracer=self.tracer,
+                                            registry=self.registry)
+        # /metrics + /healthz exporter; each process binds its own port so
+        # a scraper sees every host of a multi-process run.
+        self.exporter: Optional[MetricsExporter] = None
+        if cfg.metrics_port > 0:
+            self.exporter = MetricsExporter(
+                self.registry,
+                port=cfg.metrics_port + jax.process_index(),
+                health_fn=self._health_status,
+                collect=[self._update_memory_gauges]).start()
         # MFU inputs: per-step FLOPs are traced lazily at step 1 (the step
         # must exist first); the chips' peak is a device_kind lookup (None
         # off-TPU -> mfu reported as null, never a fiction).
@@ -243,6 +277,71 @@ class Trainer:
             return s.get("kv_retries", 0) > 0 or s.get("kv_giveups", 0) > 0
         return False
 
+    # ---- live ops plane ----
+    def _update_memory_gauges(self) -> None:
+        """HBM/RSS watermarks into the registry — called per step AND as an
+        exporter collect hook, so a scrape between steps still sees fresh
+        memory pressure."""
+        mem = device_memory_record()
+        if mem:
+            self.registry.set("device_mem_peak_bytes",
+                              mem.get("device_mem_peak_bytes", 0))
+            self.registry.set("device_mem_bytes",
+                              mem.get("device_mem_bytes", 0))
+        self.registry.set("host_rss_bytes", host_rss_bytes())
+
+    def _health_status(self) -> dict:
+        """/healthz body: watchdog state (stall evaluated on demand from the
+        exporter thread — a wedged step loop can't self-report) + identity."""
+        body = self.health.status() if self.health is not None else {"ok": True}
+        body["process_index"] = jax.process_index()
+        body["run_id"] = self.coordinator.run_id
+        return body
+
+    def _ops_step(self, step: int, *, loss=None, grad_norm=None,
+                  nonfinite=None, step_time=None, data_time=None) -> None:
+        """One step's worth of live-ops bookkeeping: registry gauges, memory
+        watermarks, flight-recorder step record, and the health watchdogs.
+        loss/grad_norm/nonfinite are the PREVIOUS step's values — already on
+        the host via the 1-deep pipeline's existing sync, so this adds no
+        device round-trip."""
+        r = self.registry
+        r.inc("train_steps")
+        r.set("train_step", step)
+        if loss is not None:
+            r.set("train_loss", loss)
+        if grad_norm is not None:
+            r.set("train_grad_norm", grad_norm)
+        if step_time is not None and step_time > 0:
+            r.set("train_step_time_s", step_time)
+            r.observe("train_step_latency_s", step_time)
+            r.set("train_examples_per_sec", self.cfg.batch_size / step_time)
+        if data_time is not None:
+            r.set("train_data_time_s", data_time)
+        self._update_memory_gauges()
+        if self.flightrec is not None:
+            self.flightrec.record_step(step, loss=loss, grad_norm=grad_norm,
+                                       step_time=step_time,
+                                       data_time=data_time)
+        if self.health is not None:
+            for ev in self.health.observe_step(
+                    step, loss=loss, grad_norm=grad_norm,
+                    nonfinite=nonfinite, step_time=step_time):
+                if self.flightrec is not None:
+                    self.flightrec.record_health(ev)
+                print(f"HEALTH {ev.detector} ({ev.action}): {ev.message}")
+
+    def _halt_for_health(self, step: int) -> None:
+        """The checkpoint-and-halt action: commit an emergency checkpoint,
+        dump the flight recorder, leave the loop (caller breaks)."""
+        ev = self.health.halt_event
+        with self.tracer.span("checkpoint", step=step):
+            self._checkpoint(step)
+        if self.flightrec is not None:
+            self.flightrec.dump(f"watchdog:{ev.detector}",
+                                extra={"halt": ev.to_dict()})
+        print(f"HEALTH halt at step {step}: {ev.message}")
+
     def train(self):
         """Run to max_steps (or epochs * steps-per-epoch, whichever is
         smaller — reference semantics: both bounds live on the CLI,
@@ -254,6 +353,7 @@ class Trainer:
         step = self.start_step
         m_prev = None
         preempted = False
+        halted = False
         self._preempt.install()
         try:
             while step < last_step:
@@ -282,6 +382,17 @@ class Trainer:
                     x, y = self.train_loader.next_batch()
                 t_data = time.monotonic() - t0
                 mask = self.coordinator.participation_mask(step)
+                if self.injector is not None and \
+                        self.injector.maybe_poison(step):
+                    # grad_nan fault: NaN rides the mask into the step's
+                    # psums (loss/grad-average/grad-norm all blow up) with
+                    # no recompile; the all-NaN mask also fails the
+                    # `msum > 0` guard so params stay clean regardless.
+                    mask = np.asarray(mask, np.float32) * np.nan
+                    print(f"FAULT grad_nan: poisoned mask at step {step}")
+                    if self.flightrec is not None:
+                        self.flightrec.record_event(
+                            "fault_grad_nan", {"step": step})
                 # Legacy uint32[2] key: globalizable as a plain replicated array
                 # (typed key dtypes can't cross make_array_from_callback).
                 key = np.asarray(jax.random.PRNGKey(cfg.seed * 100003 + step))
@@ -312,13 +423,28 @@ class Trainer:
                 # kofn/deadline policies never act on stale numbers (the round-1
                 # telemetry was gated on log_every; the reference timed every
                 # worker step, distributed_worker.py:169-173).
+                prev = None
                 with self.tracer.span("device_sync", step=step):
                     if m_prev is not None:
-                        _ = float(m_prev["loss"])
+                        # The previous step's metrics materialize here either
+                        # way; reading three scalars from the same (already
+                        # synced) device buffer is free — this is where the
+                        # watchdogs get their values at zero extra syncs.
+                        prev = {"loss": float(m_prev["loss"])}
+                        if "grad_norm" in m_prev:
+                            prev["grad_norm"] = float(m_prev["grad_norm"])
+                        if "nonfinite" in m_prev:
+                            prev["nonfinite"] = float(m_prev["nonfinite"])
                 m_prev = m
                 t_step = time.monotonic() - t0
                 for r in self._local_replicas:
                     self.coordinator.report_duration(r, step, t_step)
+                self._ops_step(step, step_time=t_step, data_time=t_data,
+                               **(prev or {}))
+                if self.health is not None and self.health.should_halt:
+                    self._halt_for_health(step)
+                    halted = True
+                    break
                 if self._telemetry is not None:
                     rec = {
                         "step_time": round(t_step, 6),
@@ -364,14 +490,43 @@ class Trainer:
                     with self.tracer.span("checkpoint", step=step):
                         self._checkpoint(step)
                     print(f"PREEMPT emergency checkpoint at step {step}")
+                    if self.flightrec is not None:
+                        self.flightrec.dump("sigterm", extra={"step": step})
                     preempted = True
                     break
             jax.block_until_ready(self.state.params)
-            if cfg.eval_freq > 0 and step % cfg.eval_freq != 0 and not preempted:
+            if m_prev is not None and self.health is not None and not halted:
+                # The loop's sync point trails by one step: check the LAST
+                # step's metrics too, so a NaN on the final step still trips.
+                final = {"loss": float(m_prev["loss"])}
+                if "grad_norm" in m_prev:
+                    final["grad_norm"] = float(m_prev["grad_norm"])
+                if "nonfinite" in m_prev:
+                    final["nonfinite"] = float(m_prev["nonfinite"])
+                for ev in self.health.observe_step(step, **final):
+                    if self.flightrec is not None:
+                        self.flightrec.record_health(ev)
+                    print(f"HEALTH {ev.detector} ({ev.action}): {ev.message}")
+                if self.health.should_halt and not preempted:
+                    self._halt_for_health(step)
+                    halted = True
+            if cfg.eval_freq > 0 and step % cfg.eval_freq != 0 \
+                    and not preempted and not halted:
                 with self.tracer.span("checkpoint", step=step):
                     self._checkpoint(step)
+        except BaseException as e:
+            # The flight dump happens while the exception is in flight so a
+            # crash post-mortem exists even when nothing catches it upstream;
+            # dump() itself never raises (it must not mask the real error).
+            if self.flightrec is not None:
+                self.flightrec.record_event(
+                    "exception", {"type": type(e).__name__, "message": str(e)})
+                self.flightrec.dump(f"crash:{type(e).__name__}")
+            raise
         finally:
             self._preempt.uninstall()
+            if self.exporter is not None:
+                self.exporter.stop()
             # Telemetry sinks close on ANY exit — a trainer exception must
             # not leak the JSONL handle or lose the trace collected so far.
             if self._trace_active:

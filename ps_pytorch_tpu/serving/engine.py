@@ -349,13 +349,20 @@ class ServingEngine:
 def serve_loop(engine: ServingEngine, queue, *, watcher=None,
                reload_s: float = 10.0, stop: Optional[threading.Event] = None,
                idle_wait_s: float = 0.02,
-               clock: Callable[[], float] = time.monotonic) -> None:
+               clock: Callable[[], float] = time.monotonic,
+               health=None) -> None:
     """The serving drive loop (one thread): admit from the queue while slots
     are free, tick the engine while anything is active, and poll the
     checkpoint watcher every ``reload_s`` — params swap BETWEEN ticks, so a
-    reload never lands mid-decode. Runs until ``stop`` is set."""
+    reload never lands mid-decode. Runs until ``stop`` is set.
+
+    ``health`` (a telemetry ``HealthMonitor``) is beaten once per loop
+    iteration so its stall detector watches THIS thread — a hung jit'd tick
+    or a deadlocked admission path shows up in ``/healthz``."""
     last_reload = clock()
     while stop is None or not stop.is_set():
+        if health is not None:
+            health.beat()
         admitted = False
         while engine.free_slots > 0:
             req = queue.take()

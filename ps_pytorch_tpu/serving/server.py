@@ -11,8 +11,12 @@ API (JSON in, JSON out):
   ``top_k`` / ``seed`` / ``deadline_s``. 200 → ``{"tokens", "text",
   "ttft_ms", "latency_ms", "model_step", "rid"}``; 400 invalid request;
   503 queue full (backpressure); 504 deadline shed or timeout.
-- ``GET /healthz``        liveness + slot/queue occupancy.
+- ``GET /healthz``        liveness + slot/queue occupancy (+ watchdog state
+  when the frontend was built with a ``HealthMonitor``). Always HTTP 200 —
+  orchestration liveness probes key on the ``ok`` field, not the status.
 - ``GET /stats``          engine/queue counters (+ registry snapshot).
+- ``GET /metrics``        Prometheus text exposition of the engine registry
+  (404 when the engine was built without one).
 """
 
 import json
@@ -26,6 +30,7 @@ import numpy as np
 
 from ps_pytorch_tpu.serving.engine import Request, ServingEngine, serve_loop
 from ps_pytorch_tpu.serving.queue import AdmissionQueue
+from ps_pytorch_tpu.telemetry.prometheus import CONTENT_TYPE, render
 
 
 class ServingFrontend:
@@ -39,8 +44,9 @@ class ServingFrontend:
                  host: str = "127.0.0.1", port: int = 0,
                  max_queue: int = 64, reload_s: float = 10.0,
                  default_deadline_s: float = 30.0,
-                 default_n_new: int = 128):
+                 default_n_new: int = 128, health=None):
         self.engine = engine
+        self.health = health
         self.queue = AdmissionQueue(max_queue, clock=engine.clock,
                                     registry=engine.registry)
         self.watcher = watcher
@@ -59,7 +65,8 @@ class ServingFrontend:
         self._loop = threading.Thread(
             target=serve_loop, args=(self.engine, self.queue),
             kwargs=dict(watcher=self.watcher, reload_s=self.reload_s,
-                        stop=self._stop, clock=self.engine.clock),
+                        stop=self._stop, clock=self.engine.clock,
+                        health=self.health),
             daemon=True, name="serve-loop")
         self._loop.start()
         frontend = self
@@ -181,12 +188,30 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(payload)
 
+    def _send_text(self, code: int, text: str, content_type: str) -> None:
+        payload = text.encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
     def do_GET(self):
         if self.path == "/healthz":
             e = self.fe.engine
-            self._send(200, {"ok": True, "slots_free": e.free_slots,
-                             "queue_depth": self.fe.queue.depth(),
-                             "model_step": e.model_step})
+            out = {"ok": True, "slots_free": e.free_slots,
+                   "queue_depth": self.fe.queue.depth(),
+                   "model_step": e.model_step}
+            if self.fe.health is not None:
+                out["health"] = self.fe.health.status()
+                out["ok"] = bool(out["health"]["ok"])
+            self._send(200, out)
+        elif self.path == "/metrics":
+            reg = self.fe.engine.registry
+            if reg is None:
+                self._send(404, {"error": "engine has no metric registry"})
+            else:
+                self._send_text(200, render(reg), CONTENT_TYPE)
         elif self.path == "/stats":
             self._send(200, self.fe.stats())
         else:

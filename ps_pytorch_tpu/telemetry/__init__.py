@@ -1,14 +1,30 @@
 """Unified telemetry: span tracing (trace.py), typed metric registry with
-MFU/goodput derivation (registry.py), and cross-host step aggregation over
-the control-plane KV (aggregate.py). See each module's docstring."""
+MFU/goodput derivation (registry.py), cross-host step aggregation over the
+control-plane KV (aggregate.py), and the live ops plane — Prometheus
+exposition/exporter (prometheus.py), training-health watchdogs (health.py),
+and the crash-dump flight recorder (flightrec.py). See each module's
+docstring."""
 
 from ps_pytorch_tpu.telemetry.aggregate import (  # noqa: F401
     TelemetryAggregator, read_timeline,
 )
+from ps_pytorch_tpu.telemetry.flightrec import (  # noqa: F401
+    FlightRecorder, load_flight,
+)
+from ps_pytorch_tpu.telemetry.health import (  # noqa: F401
+    HealthEvent, HealthMonitor, parse_health_spec,
+)
+from ps_pytorch_tpu.telemetry.prometheus import (  # noqa: F401
+    MetricsExporter, parse_exposition, render as render_prometheus,
+    sanitize_name,
+)
 from ps_pytorch_tpu.telemetry.registry import (  # noqa: F401
-    RESILIENCE_COUNTERS, MetricSpec, Registry, aggregate_peak_flops,
+    RESILIENCE_COUNTERS, SERVING_COUNTERS, SERVING_GAUGES,
+    SERVING_HISTOGRAMS, TRAINING_COUNTERS, TRAINING_GAUGES,
+    TRAINING_HISTOGRAMS, MetricSpec, Registry, aggregate_peak_flops,
     compute_mfu, data_stall_fraction, declare_resilience_metrics,
-    derive_step_record, device_memory_record, step_flops_of,
+    declare_serving_metrics, declare_training_metrics, derive_step_record,
+    device_memory_record, host_rss_bytes, step_flops_of,
 )
 from ps_pytorch_tpu.telemetry.trace import (  # noqa: F401
     Tracer, get_default_tracer, set_default_tracer, span,

@@ -194,6 +194,7 @@ RESILIENCE_COUNTERS = (
     ("crashes", "events", "injected replica crashes fired"),
     ("ckpt_corruptions", "events",
      "injected post-commit checkpoint corruptions"),
+    ("grad_nans", "events", "injected NaN-gradient steps"),
     ("kv_retries", "ops", "KV ops retried after a transient error"),
     ("kv_giveups", "ops", "KV ops failed after retries/budget ran out"),
     ("evictions", "events", "replicas evicted for missed heartbeats"),
@@ -241,6 +242,58 @@ def declare_serving_metrics(registry: Registry) -> Registry:
     for name, unit, help_ in SERVING_HISTOGRAMS:
         registry.histogram(name, unit=unit, help=help_)
     return registry
+
+
+# ---- training metric contract (ps_pytorch_tpu/runtime/ trainers) ----
+#
+# The live ops plane (telemetry/prometheus.py --metrics-port exporter)
+# renders whatever the Registry holds; this tuple is the reviewable list of
+# what the TRAINERS put there each step. Names mirror the MetricsLogger
+# JSONL fields so a dashboard and a post-hoc analysis read the same
+# vocabulary.
+TRAINING_COUNTERS = (
+    ("train_steps", "steps", "training steps completed"),
+)
+TRAINING_GAUGES = (
+    ("train_step", "step", "current training step"),
+    ("train_loss", "", "last step's training loss"),
+    ("train_grad_norm", "", "last step's global gradient norm"),
+    ("train_step_time_s", "s", "last step's wall time"),
+    ("train_data_time_s", "s", "last step's input-pipeline wait"),
+    ("train_examples_per_sec", "examples/s", "last step's goodput"),
+    ("device_mem_peak_bytes", "bytes",
+     "device HBM peak bytes in use (0 when the backend has no stats)"),
+    ("device_mem_bytes", "bytes",
+     "device HBM bytes in use (0 when the backend has no stats)"),
+    ("host_rss_bytes", "bytes", "host process peak RSS watermark"),
+)
+TRAINING_HISTOGRAMS = (
+    ("train_step_latency_s", "s", "per-step wall-time distribution"),
+)
+
+
+def declare_training_metrics(registry: Registry) -> Registry:
+    """Declare the trainer-side counters/gauges/histograms on ``registry``."""
+    for name, unit, help_ in TRAINING_COUNTERS:
+        registry.counter(name, unit=unit, help=help_)
+    for name, unit, help_ in TRAINING_GAUGES:
+        registry.gauge(name, unit=unit, help=help_)
+    for name, unit, help_ in TRAINING_HISTOGRAMS:
+        registry.histogram(name, unit=unit, help=help_)
+    return registry
+
+
+def host_rss_bytes() -> int:
+    """Peak resident-set watermark of this process via getrusage (no
+    psutil dependency). ru_maxrss is KiB on Linux, bytes on macOS; 0 when
+    the platform offers neither."""
+    try:
+        import resource
+        import sys
+        rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        return int(rss if sys.platform == "darwin" else rss * 1024)
+    except Exception:
+        return 0
 
 
 # ---- derived per-step arithmetic (one definition; PERF.md cites this) ----

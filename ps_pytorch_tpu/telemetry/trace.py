@@ -67,12 +67,17 @@ class Tracer:
     @contextmanager
     def span(self, name: str, step: Optional[int] = None, **args):
         """Nestable timed region. Nesting depth is carried implicitly by
-        start/end containment (Perfetto stacks overlapping same-tid spans)."""
+        start/end containment (Perfetto stacks overlapping same-tid spans).
+
+        Yields the span's mutable args dict — recorded at EXIT, so code
+        inside the region can attach facts it only learns mid-span
+        (``sargs["corr"] = ...`` for cross-process stitching, byte counts,
+        versions) without a second recording API."""
         stack = self._stack()
         stack.append(name)
         t0 = time.monotonic()
         try:
-            yield self
+            yield args
         finally:
             t1 = time.monotonic()
             stack.pop()
@@ -178,10 +183,13 @@ def get_default_tracer() -> Optional[Tracer]:
 @contextmanager
 def span(name: str, step: Optional[int] = None, **args):
     """Record into the default tracer; a zero-cost no-op when none is set
-    (library code stays importable and fast without telemetry wired up)."""
+    (library code stays importable and fast without telemetry wired up).
+    With a tracer installed, yields the span's mutable args dict (see
+    Tracer.span); without one, yields None — callers guard with
+    ``if sargs is not None``."""
     t = _default
     if t is None:
         yield None
     else:
-        with t.span(name, step=step, **args):
-            yield t
+        with t.span(name, step=step, **args) as sargs:
+            yield sargs

@@ -42,6 +42,15 @@ encode/put/decode seconds + bytes, and the publish/read overlap fractions
 
     python -m ps_pytorch_tpu.tools.analyze wire /tmp/wire_spans.jsonl
     python -m ps_pytorch_tpu.tools.analyze wire trace.json --json
+
+Flight mode renders a flight-recorder crash dump (telemetry/flightrec.py)
+as a post-mortem: health events, recent steps/spans/events, and the final
+metric snapshot. Stitch mode merges per-process Chrome traces into one and
+adds flow events joining each worker's wire_publish to the leader's
+wire_read via the correlation id transport.py stamps on both legs:
+
+    python -m ps_pytorch_tpu.tools.analyze flight ./train_dir/flightrec.json
+    python -m ps_pytorch_tpu.tools.analyze stitch 'trace.json*' --out all.json
 """
 
 import argparse
@@ -454,6 +463,141 @@ def faults_main(args, parser) -> int:
     return 0
 
 
+# ---- flight mode (flight-recorder post-mortem) ----
+
+def flight_markdown(doc: dict) -> str:
+    lines = [f"# flight recorder: {doc.get('reason', '?')}",
+             f"written pid={doc.get('pid')} dumps={doc.get('dumps')}", ""]
+    health = doc.get("health_events", [])
+    if health:
+        lines.append("## health events")
+        lines.append("| step | detector | action | value | threshold |")
+        lines.append("|---|---|---|---|---|")
+        for h in health:
+            lines.append(f"| {h.get('step')} | {h.get('detector')} | "
+                         f"{h.get('action')} | {h.get('value')} | "
+                         f"{h.get('threshold')} |")
+        lines.append("")
+    events = doc.get("events", [])
+    if events:
+        lines.append("## events")
+        for ev in events[-16:]:
+            payload = {k: v for k, v in ev.items() if k not in ("kind", "t")}
+            lines.append(f"- {ev.get('kind')}: {json.dumps(payload)}")
+        lines.append("")
+    steps = doc.get("steps", [])
+    if steps:
+        lines.append(f"## last {min(len(steps), 8)} of {len(steps)} steps")
+        keys = sorted({k for s in steps[-8:] for k in s})
+        lines.append("| " + " | ".join(keys) + " |")
+        lines.append("|" + "---|" * len(keys))
+        for s in steps[-8:]:
+            lines.append("| " + " | ".join(
+                str(s.get(k, "")) for k in keys) + " |")
+        lines.append("")
+    spans = doc.get("spans", [])
+    if spans:
+        tail = spans[-12:]
+        lines.append(f"## last {len(tail)} of {len(spans)} spans")
+        for s in tail:
+            lines.append(f"- {s.get('name')} step={s.get('step')} "
+                         f"dur={s.get('dur', 0):.4f}s")
+        lines.append("")
+    final = doc.get("final_metrics") or {}
+    if final:
+        lines.append("## final metric snapshot")
+        for k in sorted(final):
+            lines.append(f"- {k}: {final[k]}")
+    return "\n".join(lines)
+
+
+def flight_main(args, parser) -> int:
+    from ps_pytorch_tpu.telemetry.flightrec import load_flight
+    files: List[str] = []
+    for pattern in args.runs:
+        files.extend(sorted(glob.glob(pattern)) or
+                     parser.error(f"no files match {pattern!r}") or [])
+    for path in files:
+        doc = load_flight(path)
+        if args.json:
+            print(json.dumps(doc))
+        else:
+            print(flight_markdown(doc))
+    return 0
+
+
+# ---- stitch mode (cross-process trace merge with wire flow events) ----
+
+def stitch_chrome_traces(docs: List[dict]) -> tuple:
+    """Merge per-process Chrome traces into one doc and add flow events
+    joining each worker's ``wire_publish``/``wire_put`` span to the
+    leader's matching ``wire_read``/``get_decode`` span via the correlation
+    id (``args.corr``, stamped by transport.py on both legs).
+
+    Flow ids are ``zlib.crc32(corr)`` — deterministic, so re-stitching the
+    same traces yields identical ids. Returns ``(merged_doc, n_flows)``."""
+    import zlib
+    merged: List[dict] = []
+    pubs: Dict[str, dict] = {}
+    reads: Dict[str, List[dict]] = {}
+    for doc in docs:
+        for e in doc.get("traceEvents", []):
+            merged.append(e)
+            corr = (e.get("args") or {}).get("corr")
+            if e.get("ph") != "X" or not corr:
+                continue
+            if e["name"] in ("wire_publish", "wire_put"):
+                # Last publisher wins: one writer per corr by construction
+                # (the version/bucket id is in the corr string).
+                pubs[corr] = e
+            elif e["name"] in ("wire_read", "get_decode"):
+                reads.setdefault(corr, []).append(e)
+    flows: List[dict] = []
+    for corr, pub in sorted(pubs.items()):
+        for rd in reads.get(corr, []):
+            fid = zlib.crc32(corr.encode("utf-8"))
+            flows.append({"ph": "s", "cat": "wire", "name": "wire_flow",
+                          "id": fid, "pid": pub["pid"], "tid": pub["tid"],
+                          "ts": pub["ts"] + pub.get("dur", 0),
+                          "args": {"corr": corr}})
+            flows.append({"ph": "f", "bp": "e", "cat": "wire",
+                          "name": "wire_flow", "id": fid, "pid": rd["pid"],
+                          "tid": rd["tid"], "ts": rd["ts"],
+                          "args": {"corr": corr}})
+    out = {"traceEvents": merged + flows, "displayTimeUnit": "ms",
+           "metadata": {"stitched_from": len(docs),
+                        "wire_flows": len(flows) // 2}}
+    return out, len(flows) // 2
+
+
+def stitch_main(args, parser) -> int:
+    files: List[str] = []
+    for pattern in args.runs:
+        files.extend(sorted(glob.glob(pattern)) or
+                     parser.error(f"no files match {pattern!r}") or [])
+    docs = []
+    for path in files:
+        with open(path) as f:
+            doc = json.load(f)
+        if not isinstance(doc, dict) or "traceEvents" not in doc:
+            parser.error(f"{path} is not a Chrome trace "
+                         f"(no traceEvents)")
+        docs.append(doc)
+    merged, n_flows = stitch_chrome_traces(docs)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(merged, f)
+    summary = {"files": len(files), "events": len(merged["traceEvents"]),
+               "wire_flows": n_flows, "out": args.out or None}
+    if args.json:
+        print(json.dumps(summary))
+    else:
+        print(f"stitched {summary['files']} traces -> "
+              f"{summary['events']} events, {n_flows} wire flow pairs"
+              + (f" -> {args.out}" if args.out else ""))
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("runs", nargs="+",
@@ -462,8 +606,16 @@ def main(argv=None) -> int:
     p.add_argument("--baseline", default="", help="label to normalize against")
     p.add_argument("--skip-first", type=int, default=1)
     p.add_argument("--json", action="store_true", help="emit JSON rows instead")
+    p.add_argument("--out", default="",
+                   help="stitch mode: write the merged Chrome trace here")
     args = p.parse_args(argv)
 
+    if args.runs[0] == "flight":
+        args.runs = args.runs[1:] or p.error("flight mode needs FILE...")
+        return flight_main(args, p)
+    if args.runs[0] == "stitch":
+        args.runs = args.runs[1:] or p.error("stitch mode needs FILE...")
+        return stitch_main(args, p)
     if args.runs[0] == "timeline":
         args.runs = args.runs[1:] or p.error("timeline mode needs FILE...")
         return timeline_main(args, p)

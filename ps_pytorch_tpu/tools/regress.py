@@ -1,0 +1,275 @@
+#!/usr/bin/env python
+"""Bench regression gate: compare a fresh benchmark artifact against the
+newest committed one of the same family and fail (non-zero exit) when a
+watched metric moved past its tolerance in the bad direction.
+
+Families and their watched metrics (direction, relative tolerance):
+
+- ``wire``       BENCH_WIRE_r*.json     publish_s/read_s/total_s lower-is-
+                                        better, 20% (host RTT noise)
+- ``serve``      BENCH_SERVE_r*.json    tokens_per_sec higher-is-better,
+                                        ttft_p99_ms/latency_p99_ms lower,
+                                        25% (tail percentiles are noisy)
+- ``suite``      BENCH_SUITE_r*.json    images_per_sec higher, 20%
+- ``ops``        BENCH_OPS_r*.json      overhead_frac must stay < 0.02
+                                        absolute (the exporter+watchdog
+                                        budget, not a relative drift)
+- ``resilience`` RESILIENCE_r*.json     boolean invariants must stay true
+                                        (bitwise_equal/ok) and kv_giveups 0
+
+Rows are matched by their "config" name — a config present in the baseline
+but missing from the candidate is a failure (silently dropping a bench row
+is how regressions hide), while new configs pass with a note.
+
+    python -m ps_pytorch_tpu.tools.regress wire /tmp/new_wire.json
+    python -m ps_pytorch_tpu.tools.regress all --out REGRESS_r11.json
+
+``all`` mode self-checks each family's newest committed artifact against
+its previous round (skipping families with fewer than two rounds) — the
+mode that generates the committed REGRESS_r*.json and the report row.
+"""
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional
+
+# (metric, direction, relative tolerance). Direction "lower"/"higher" is
+# which way is BETTER; a move past tol in the other way is a regression.
+FAMILIES: Dict[str, dict] = {
+    "wire": {
+        "pattern": "BENCH_WIRE_r[0-9]*.json",
+        "metrics": [("publish_s", "lower", 0.20),
+                    ("read_s", "lower", 0.20),
+                    ("total_s", "lower", 0.20)],
+    },
+    "serve": {
+        "pattern": "BENCH_SERVE_r[0-9]*.json",
+        "metrics": [("tokens_per_sec", "higher", 0.25),
+                    ("ttft_p99_ms", "lower", 0.25),
+                    ("latency_p99_ms", "lower", 0.25)],
+    },
+    "suite": {
+        "pattern": "BENCH_SUITE_r[0-9]*.json",
+        "metrics": [("images_per_sec", "higher", 0.20)],
+    },
+    "ops": {
+        "pattern": "BENCH_OPS_r[0-9]*.json",
+        "metrics": [],              # absolute budget check, see _check_ops
+        "absolute": [("overhead_frac", 0.02)],
+    },
+    "resilience": {
+        "pattern": "RESILIENCE_r[0-9]*.json",
+        "metrics": [],              # invariant check, see _check_resilience
+        "bools": ["bitwise_equal", "ok"],
+        "zero_counters": ["kv_giveups"],
+    },
+}
+
+
+def _round_of(path: str) -> int:
+    m = re.search(r"_r0*(\d+)", os.path.basename(path))
+    return int(m.group(1)) if m else -1
+
+
+def _committed(family: str, repo: str) -> List[str]:
+    """Committed artifact paths of a family, oldest round first."""
+    paths = glob.glob(os.path.join(repo, FAMILIES[family]["pattern"]))
+    return sorted(paths, key=lambda p: (_round_of(p), p))
+
+
+def load_artifact(path: str):
+    """Whole-JSON dict or JSON-lines list (same contract as report._load),
+    but malformed artifacts raise — a gate must not pass on garbage."""
+    with open(path) as f:
+        text = f.read().strip()
+    try:
+        return json.loads(text)
+    except ValueError:
+        rows = [json.loads(line) for line in text.splitlines() if line]
+        if not rows or not all(isinstance(r, dict) for r in rows):
+            raise ValueError(f"unparseable artifact: {path}")
+        return rows
+
+
+def _by_config(rows) -> Dict[str, dict]:
+    if isinstance(rows, dict):
+        rows = [rows]
+    return {r["config"]: r for r in rows if "config" in r}
+
+
+def _check_metric(base: float, cand: float, direction: str,
+                  tol: float) -> dict:
+    """ratio is candidate/baseline; ok when the bad-direction move stays
+    within tol (a zero/negative baseline can't be ratioed — pass, noted)."""
+    if not base or base <= 0:
+        return {"base": base, "cand": cand, "ratio": None, "ok": True,
+                "note": "baseline not positive; skipped"}
+    ratio = cand / base
+    ok = (ratio <= 1.0 + tol) if direction == "lower" else \
+         (ratio >= 1.0 - tol)
+    return {"base": base, "cand": cand, "ratio": round(ratio, 4), "ok": ok}
+
+
+def compare(family: str, baseline, candidate) -> dict:
+    """One family's gate: {"family", "ok", "configs": {...}} with a per-
+    config, per-metric breakdown. Raises KeyError on unknown family."""
+    spec = FAMILIES[family]
+    if family == "resilience":
+        return _check_resilience(spec, candidate)
+    if family == "ops":
+        return _check_ops(spec, candidate)
+    base_rows, cand_rows = _by_config(baseline), _by_config(candidate)
+    configs: Dict[str, dict] = {}
+    ok = True
+    for name, brow in sorted(base_rows.items()):
+        crow = cand_rows.get(name)
+        if crow is None:
+            configs[name] = {"ok": False, "note": "config missing from "
+                                                  "candidate"}
+            ok = False
+            continue
+        checks = {}
+        for metric, direction, tol in spec["metrics"]:
+            if metric not in brow or metric not in crow:
+                continue
+            checks[metric] = _check_metric(float(brow[metric]),
+                                           float(crow[metric]),
+                                           direction, tol)
+            ok = ok and checks[metric]["ok"]
+        configs[name] = {"ok": all(c["ok"] for c in checks.values()),
+                         "metrics": checks}
+    for name in sorted(set(cand_rows) - set(base_rows)):
+        configs[name] = {"ok": True, "note": "new config (no baseline)"}
+    return {"family": family, "ok": ok, "configs": configs}
+
+
+def _check_ops(spec: dict, candidate) -> dict:
+    configs: Dict[str, dict] = {}
+    ok = True
+    for name, row in sorted(_by_config(candidate).items()):
+        checks = {}
+        for metric, budget in spec["absolute"]:
+            val = float(row.get(metric, float("inf")))
+            checks[metric] = {"cand": val, "budget": budget,
+                              "ok": val < budget}
+            ok = ok and checks[metric]["ok"]
+        configs[name] = {"ok": all(c["ok"] for c in checks.values()),
+                         "metrics": checks}
+    if not configs:
+        ok = False
+        configs["_empty"] = {"ok": False, "note": "no ops rows"}
+    return {"family": "ops", "ok": ok, "configs": configs}
+
+
+def _check_resilience(spec: dict, candidate) -> dict:
+    doc = candidate if isinstance(candidate, dict) else \
+        (candidate[0] if candidate else {})
+    checks: Dict[str, dict] = {}
+    ok = True
+    for key in spec["bools"]:
+        if key in doc:
+            checks[key] = {"cand": doc[key], "ok": bool(doc[key])}
+            ok = ok and checks[key]["ok"]
+    counters = doc.get("counters", {})
+    for key in spec["zero_counters"]:
+        if key in counters:
+            checks[key] = {"cand": counters[key],
+                           "ok": counters[key] == 0}
+            ok = ok and checks[key]["ok"]
+    if not checks:
+        ok = False
+        checks["_empty"] = {"ok": False, "note": "no invariants found"}
+    return {"family": "resilience", "ok": ok,
+            "configs": {"invariants": {"ok": ok, "metrics": checks}}}
+
+
+def run_gate(family: str, candidate_path: str, repo: str = ".",
+             baseline_path: str = "") -> dict:
+    """Gate one candidate artifact against the newest committed baseline
+    (or an explicit one). The candidate file itself is excluded from the
+    baseline search so gating an already-committed artifact compares
+    against its predecessor."""
+    candidate = load_artifact(candidate_path)
+    baseline = None
+    if family not in ("resilience", "ops"):
+        if baseline_path:
+            baseline = load_artifact(baseline_path)
+        else:
+            cand_real = os.path.realpath(candidate_path)
+            prior = [p for p in _committed(family, repo)
+                     if os.path.realpath(p) != cand_real]
+            if not prior:
+                return {"family": family, "ok": True, "configs": {},
+                        "note": "no committed baseline; gate passes"}
+            baseline_path = prior[-1]
+            baseline = load_artifact(baseline_path)
+    out = compare(family, baseline, candidate)
+    out["candidate"] = os.path.basename(candidate_path)
+    out["baseline"] = os.path.basename(baseline_path) if baseline_path \
+        else None
+    return out
+
+
+def run_all(repo: str = ".") -> dict:
+    """Self-check every family's newest committed artifact against its
+    previous round. Families with <2 rounds are skipped (noted, not
+    failed); resilience/ops validate their single newest artifact."""
+    families: Dict[str, dict] = {}
+    ok = True
+    for family in FAMILIES:
+        paths = _committed(family, repo)
+        if not paths:
+            families[family] = {"family": family, "ok": True,
+                                "note": "no committed artifacts; skipped"}
+            continue
+        if family in ("resilience", "ops"):
+            families[family] = run_gate(family, paths[-1], repo=repo)
+        elif len(paths) < 2:
+            families[family] = {"family": family, "ok": True,
+                                "note": "only one round; skipped"}
+        else:
+            families[family] = run_gate(family, paths[-1], repo=repo,
+                                        baseline_path=paths[-2])
+        ok = ok and families[family]["ok"]
+    return {"kind": "regress", "ok": ok, "families": families}
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("family", choices=sorted(FAMILIES) + ["all"])
+    p.add_argument("candidate", nargs="?", default="",
+                   help="fresh artifact to gate (omitted in 'all' mode)")
+    p.add_argument("--repo", default=".",
+                   help="repo root holding the committed baselines")
+    p.add_argument("--baseline", default="",
+                   help="explicit baseline artifact (default: newest "
+                        "committed round)")
+    p.add_argument("--out", default="",
+                   help="also write the verdict JSON here (REGRESS_rN.json)")
+    args = p.parse_args(argv)
+
+    try:
+        if args.family == "all":
+            verdict = run_all(repo=args.repo)
+        else:
+            if not args.candidate:
+                p.error(f"family {args.family!r} needs a candidate artifact")
+            verdict = run_gate(args.family, args.candidate, repo=args.repo,
+                               baseline_path=args.baseline)
+    except (OSError, ValueError) as e:
+        p.error(str(e))
+    if args.out:
+        tmp = f"{args.out}.tmp{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(verdict, f, indent=1)
+        os.replace(tmp, args.out)
+    print(json.dumps(verdict, indent=1))
+    return 0 if verdict["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

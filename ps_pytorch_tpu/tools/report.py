@@ -295,6 +295,42 @@ def collect(repo: str):
                     and r.get("latency_p99_ms") is not None
                     for r in wins),
             "errors": errors})
+    p = _newest("BENCH_OPS_r[0-9]*.json", repo)
+    if p:
+        # Ops-plane overhead evidence (bench_suite ops_overhead row):
+        # ok means the exporter+watchdog+flight-recorder work added <2%
+        # to the bare step loop — the budget the regress gate enforces.
+        rows = _load(p)
+        if isinstance(rows, dict):
+            rows = [rows]
+        rows = [r for r in rows if isinstance(r, dict)]
+        errors = [r.get("config", r.get("_parse_error", "?")) for r in rows
+                  if "error" in r or "_parse_error" in r]
+        head = max((r for r in rows if "overhead_frac" in r),
+                   key=lambda r: r.get("overhead_frac") or 0.0, default=None)
+        add("ops overhead", p, {
+            "rows": len(rows),
+            "value": head.get("overhead_frac") if head else None,
+            "unit": "frac of bare step loop (<0.02 budget)",
+            "platform": next((r.get("platform") for r in rows
+                              if r.get("platform")), "host"),
+            "ok": head is not None and not errors
+            and all(r.get("ok") is True for r in rows
+                    if "overhead_frac" in r),
+            "errors": errors})
+    p = _newest("REGRESS_r[0-9]*.json", repo)
+    if p:
+        # Regression-gate verdict (tools/regress.py 'all' mode): every
+        # watched bench family stayed within its tolerance of the previous
+        # committed round.
+        d = as_dict(_load(p))
+        fams = d.get("families") or {}
+        failed = sorted(k for k, v in fams.items()
+                        if isinstance(v, dict) and v.get("ok") is False)
+        add("regression", p, {
+            "value": len(fams), "unit": "families gated",
+            "failed": failed,
+            "ok": d.get("ok") is True and "_parse_error" not in d})
     p = os.path.join(repo, "COPYCHECK.json")
     if os.path.exists(p):
         d = as_dict(_load(p))

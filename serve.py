@@ -47,6 +47,7 @@ def main(argv=None) -> int:
     from ps_pytorch_tpu.serving.engine import ServingEngine
     from ps_pytorch_tpu.serving.reload import CheckpointWatcher
     from ps_pytorch_tpu.serving.server import ServingFrontend
+    from ps_pytorch_tpu.telemetry.health import HealthMonitor
     from ps_pytorch_tpu.telemetry.registry import (
         Registry, declare_serving_metrics,
     )
@@ -83,13 +84,20 @@ def main(argv=None) -> int:
                                     to_tree=to_tree,
                                     migrate=migrate_packed_qkv,
                                     start_step=step)
+    # Watchdog over the serve loop: the stall detector notices a wedged
+    # drive thread (health.beat() runs once per loop iteration) and the
+    # state shows up under /healthz's "health" key.
+    health = HealthMonitor(args.health_spec or "stall:warn",
+                           registry=registry)
     frontend = ServingFrontend(
         engine, watcher=watcher, host=args.serve_host, port=args.serve_port,
         max_queue=args.serve_max_queue, reload_s=args.serve_reload_s,
         default_deadline_s=args.serve_deadline_s,
-        default_n_new=args.serve_max_new)
+        default_n_new=args.serve_max_new, health=health)
     frontend.start()
     print(json.dumps({"serving": f"http://{args.serve_host}:{frontend.port}",
+                      "metrics": f"http://{args.serve_host}:{frontend.port}"
+                                 "/metrics",
                       "model_step": step, "slots": args.serve_slots,
                       "vocab": geo["vocab_size"],
                       "seq_len": geo["max_seq_len"]}))
