@@ -811,6 +811,66 @@ def bench_ops_overhead(name, steps, *, batch=256, reps=3):
             "overhead_frac": round(frac, 5), "ok": frac < 0.02}
 
 
+def bench_elastic_overhead(name, steps, *, batch=256, reps=3):
+    """Elastic control-plane cost row: the SAME jitted LeNet step loop
+    timed bare and with the full per-step elastic work the trainers add
+    when --elastic is on and no faults fire — heartbeat, lease refresh
+    (throttled to one write per interval), membership recompute over the
+    announcement keys, and the leader_epoch/world_size gauge updates.
+    In-process KVStore, so the row measures the control-plane arithmetic
+    itself; in a real run the throttles bound the KV traffic to a few
+    RPCs per lease interval regardless of step rate. min-of-reps on both
+    sides; the budget asserted in the row is <2%."""
+    from ps_pytorch_tpu import elastic as elx
+    from ps_pytorch_tpu.runtime.coordinator import KVStore
+    from ps_pytorch_tpu.telemetry import (
+        Registry, declare_elastic_metrics, declare_training_metrics,
+    )
+
+    state0, step_fn, x, y, mask = _build("LeNet", "synthetic_mnist", batch,
+                                         n_devices=1)
+
+    def run(elastic) -> float:
+        state = jax.tree.map(jnp.copy, state0)
+        registry = declare_training_metrics(Registry())
+        election = announcer = membership = None
+        if elastic:
+            declare_elastic_metrics(registry)
+            kv = KVStore()
+            election = elx.LeaderElection(kv, "bench", 0, 1, interval_s=1.0)
+            announcer = elx.MemberAnnouncer(kv, "bench", 0, [0],
+                                            interval_s=1.0)
+            membership = elx.MembershipRegistry(kv, "bench", 1, 1)
+            election.claim_initial()
+            announcer.join()
+        for i in range(3):
+            state, metrics = step_fn(state, x, y, mask, jax.random.key(i))
+        jax.block_until_ready(state.params)
+        t0 = time.perf_counter()
+        for i in range(steps):
+            state, metrics = step_fn(state, x, y, mask,
+                                     jax.random.key(100 + i))
+            float(metrics["loss"])
+            if elastic:
+                announcer.beat(i + 1)
+                election.refresh(i + 1)
+                membership.update(i + 1)
+                registry.set("leader_epoch", float(election.epoch))
+                registry.set("world_size",
+                             float(len(membership.members) or 1))
+        jax.block_until_ready(state.params)
+        return time.perf_counter() - t0
+
+    baseline_s = min(run(False) for _ in range(reps))
+    elastic_s = min(run(True) for _ in range(reps))
+    frac = (elastic_s - baseline_s) / baseline_s
+    return {"config": name, "platform": jax.devices()[0].platform,
+            "steps": steps, "reps": reps, "global_batch": batch,
+            "baseline_s": round(baseline_s, 5),
+            "elastic_s": round(elastic_s, 5),
+            "overhead_frac": round(frac, 5), "ok": frac < 0.02}
+
+
 CONFIGS = {
     "lenet_mnist_single": lambda steps: bench_throughput(
         "lenet_mnist_single", "LeNet", "synthetic_mnist", 128, steps,
@@ -940,6 +1000,10 @@ CONFIGS = {
     # tools/regress.py's ops family gates. --
     "ops_overhead": lambda steps: bench_ops_overhead(
         "ops_overhead", max(steps, 30)),
+    # -- elastic control plane (ISSUE 7): heartbeat + lease + membership
+    # cost per step when no faults fire; same <2% posture as ops_overhead.
+    "elastic_overhead": lambda steps: bench_elastic_overhead(
+        "elastic_overhead", max(steps, 30)),
 }
 
 

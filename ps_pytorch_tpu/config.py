@@ -120,6 +120,12 @@ class TrainConfig:
     auto_resume: int = 0             # max automatic restarts from the latest VALID checkpoint after a crash (train.py)
     leader_lease_s: float = 0.0      # leader refreshes a coordination-KV lease this often; followers raise LeaderLost when it goes stale (0 = lease off; runtime/coordinator.py)
 
+    # -- elastic control plane (ps_pytorch_tpu/elastic/: leader election,
+    #    epoch'd membership, shard rebalancing; turns LeaderLost into a
+    #    recovered event instead of a fatal one) --
+    elastic: bool = False            # epoch-fenced leader election + membership registry over the coordination KV (requires leader_lease_s > 0)
+    elastic_leader: int = 0          # process index of the INITIAL leader; on a real fleet keep it off the coordination-service host (process 0) so killing the leader doesn't kill the KV
+
     # -- serving (serve.py + ps_pytorch_tpu/serving/: continuous-batching
     #    inference over trained LM checkpoints with hot reload) --
     serve_slots: int = 8             # concurrent decode slots (the continuous batch)
@@ -212,6 +218,14 @@ class TrainConfig:
         if self.leader_lease_s < 0:
             raise ValueError(f"leader_lease_s={self.leader_lease_s} "
                              "(must be >= 0; 0 = lease off)")
+        if self.elastic and self.leader_lease_s <= 0:
+            # The election is DRIVEN by lease staleness: without a lease
+            # there is no death signal and a campaign can never start.
+            raise ValueError("elastic=True requires leader_lease_s > 0 "
+                             "(the lease is the failure detector)")
+        if self.elastic_leader < 0:
+            raise ValueError(f"elastic_leader={self.elastic_leader} "
+                             "(must be >= 0)")
         if self.serve_slots < 1:
             raise ValueError(f"serve_slots={self.serve_slots} (must be >= 1)")
         if self.serve_max_queue < 1:

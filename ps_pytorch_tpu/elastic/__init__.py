@@ -1,0 +1,35 @@
+"""Elastic control plane — election, membership, shard rebalancing.
+
+Turns ``LeaderLost`` (runtime/coordinator.py) from a fatal exception into a
+recovered event. Three pieces, all riding the same coordination KV the rest
+of the control plane uses (in-process dict in tests, the JAX coordination
+service across hosts):
+
+- election.py    lease-based leader election: compare-and-claim on an
+                 epoch-numbered lease key, deterministic tie-break by
+                 process index, epoch fencing so a deposed leader's stale
+                 writes are ignored.
+- membership.py  epoch'd membership registry on resilience/heartbeat.py:
+                 processes announce join/leave, the leader folds
+                 admissions/evictions into the participation mask at step
+                 boundaries, late joiners fast-forward from the latest
+                 valid checkpoint + current KV-published params.
+- rebalance.py   ZeRO shard-plan recompute on membership change and
+                 optimizer-state redistribution through the KV, keeping
+                 the sharded update bitwise-exact at the new N.
+
+Like resilience/, the package only needs a duck-typed KV (set/get/delete)
+and an optional shared clock, so every piece is drivable by ManualClock +
+the in-process KVStore in tests and by the real multi-process
+DistributedKV in the chaos drills.
+"""
+
+from ps_pytorch_tpu.elastic.election import (  # noqa: F401
+    Deposed, ElectionFailed, LeaderElection,
+)
+from ps_pytorch_tpu.elastic.membership import (  # noqa: F401
+    MemberAnnouncer, MembershipRegistry, read_view,
+)
+from ps_pytorch_tpu.elastic.rebalance import (  # noqa: F401
+    ShardedKVUpdate, ShardPlan, plan_shards, reslice,
+)

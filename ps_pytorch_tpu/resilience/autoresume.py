@@ -61,7 +61,16 @@ def run_with_auto_resume(make_trainer: Callable[[], object],
     """Train to completion across crashes. Returns the final ``train()``
     result. ``exceptions`` bounds what counts as recoverable — by default
     only injected crashes; pass ``(InjectedCrash, RuntimeError)`` etc. to
-    also ride out real ones. Exceeding ``max_restarts`` re-raises."""
+    also ride out real ones. Exceeding ``max_restarts`` re-raises.
+
+    Elastic interplay (``--elastic``): leader loss is handled BELOW this
+    layer — the Coordinator catches LeaderLost and runs an election
+    (elastic/election.py), so it never surfaces here. What does surface is
+    :class:`~ps_pytorch_tpu.elastic.election.ElectionFailed` (no leader
+    after max_campaigns — KV unreachable); train.py's elastic path passes
+    ``(Exception,)`` so the restart loop rebuilds the trainer, which
+    rejoins as a follower and fast-forwards from the latest valid
+    checkpoint + the leader's KV-published params."""
     restarts = 0
     while True:
         trainer = make_trainer()

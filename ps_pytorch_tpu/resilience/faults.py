@@ -32,6 +32,21 @@ Spec grammar (``;``-separated faults, each ``kind:key=val,key=val``):
         lossy codec produces — WITHOUT a recompile (the mask is already a
         float input). The health watchdogs (telemetry/health.py) are what
         must catch it.
+    leader_kill:step=6
+        SIGKILL whichever process is the CURRENT leader at step ``step``
+        (once). Role-addressed, not rank-addressed: with elections on,
+        the victim is whoever holds the lease when the step arrives, so
+        the drill kills the re-elected leader too if scheduled twice.
+        The trainer reports its role via ``maybe_kill_leader``.
+    kv_partition:r=1,step=5,steps=4
+        Drop ALL KV traffic for process(es) ``r`` (an int or a
+        ``+``-separated list, e.g. ``r=1+2``) for the step window
+        [step, step+steps) — the partition-of-a-subtree drill. Unlike
+        ``kv_drop`` this is total and deterministic: every op raises the
+        transient UNAVAILABLE while the window is open, so the retry
+        plane, lease timeouts, and elections are what must absorb it.
+        The injector learns the current step from ``maybe_crash`` (called
+        at the top of every step loop).
 
 Drop/delay decisions come from ``numpy.default_rng(seed + 10007 * pid)``:
 reproducible per process, uncorrelated across processes.
@@ -42,7 +57,8 @@ from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
-_KINDS = ("kv_drop", "kv_delay", "replica_crash", "ckpt_corrupt", "grad_nan")
+_KINDS = ("kv_drop", "kv_delay", "replica_crash", "ckpt_corrupt", "grad_nan",
+          "leader_kill", "kv_partition")
 _KV_OPS = ("set", "get", "delete")
 
 
@@ -141,6 +157,29 @@ def _validate(p: Dict[str, Any], part: str) -> None:
         if not isinstance(p.get("step"), int):
             raise ValueError(f"grad_nan needs step=<int> (got {part!r})")
         p.setdefault("r", 0)
+    elif kind == "leader_kill":
+        if not isinstance(p.get("step"), int):
+            raise ValueError(f"leader_kill needs step=<int> (got {part!r})")
+    elif kind == "kv_partition":
+        if not isinstance(p.get("step"), int):
+            raise ValueError(f"kv_partition needs step=<int> (got {part!r})")
+        if not isinstance(p.setdefault("steps", 1), int) or p["steps"] < 1:
+            raise ValueError(f"kv_partition needs steps=<int >= 1> "
+                             f"(got {part!r})")
+        # r: one process (int) or a '+'-separated subset ("1+2"); parsed
+        # into a list here so the window check is a plain membership test.
+        r = p.setdefault("r", 0)
+        if isinstance(r, int):
+            p["r"] = [r]
+        elif isinstance(r, str):
+            try:
+                p["r"] = [int(x) for x in r.split("+")]
+            except ValueError:
+                raise ValueError(f"kv_partition r must be an int or "
+                                 f"'+'-separated ints (got {part!r})")
+        else:
+            raise ValueError(f"kv_partition r must be an int or "
+                             f"'+'-separated ints (got {part!r})")
 
 
 class FaultyKV:
@@ -164,6 +203,18 @@ class FaultyKV:
 
     def _roll(self, op: str) -> None:
         for f, rng in zip(self._faults, self._rngs):
+            if f["kind"] == "kv_partition":
+                # Total, deterministic, step-windowed: no dice roll. The
+                # injector's current_step advances at each step top
+                # (maybe_crash), so the window opens/closes with the loop.
+                if self._inj.process_index in f["r"] and \
+                        f["step"] <= self._inj.current_step < \
+                        f["step"] + f["steps"]:
+                    self._inj.counters["kv_partition_drops"] += 1
+                    raise TransientKVError(
+                        f"UNAVAILABLE: injected kv_partition on {op} "
+                        f"(step {self._inj.current_step})")
+                continue
             if f.get("op") is not None and f["op"] != op:
                 continue
             if rng.random() >= f["p"]:
@@ -207,18 +258,21 @@ class FaultInjector:
         self.clock = clock or time.monotonic
         self.sleep = sleep or time.sleep
         self._fired = set()
+        self.current_step = 0
         self.counters: Dict[str, int] = {
             "kv_drops": 0, "kv_delays": 0, "crashes": 0,
-            "ckpt_corruptions": 0, "grad_nans": 0}
+            "ckpt_corruptions": 0, "grad_nans": 0, "leader_kills": 0,
+            "kv_partition_drops": 0}
 
     # ---- KV plane ----
     @property
     def has_kv_faults(self) -> bool:
-        return any(f["kind"] in ("kv_drop", "kv_delay") for f in self.faults)
+        return any(f["kind"] in ("kv_drop", "kv_delay", "kv_partition")
+                   for f in self.faults)
 
     def wrap_kv(self, kv):
         kv_faults = [f for f in self.faults
-                     if f["kind"] in ("kv_drop", "kv_delay")]
+                     if f["kind"] in ("kv_drop", "kv_delay", "kv_partition")]
         if not kv_faults:
             return kv
         return FaultyKV(kv, kv_faults, self, self.sleep)
@@ -226,7 +280,10 @@ class FaultInjector:
     # ---- step loop plane ----
     def maybe_crash(self, step: int) -> None:
         """Raise InjectedCrash when a replica_crash fault matches this
-        process and step (once). Call at the top of the step loop."""
+        process and step (once). Call at the top of the step loop — this
+        call also advances ``current_step``, the clock the step-windowed
+        faults (kv_partition) read."""
+        self.current_step = max(self.current_step, int(step))
         for i, f in enumerate(self.faults):
             if f["kind"] != "replica_crash" or ("crash", i) in self._fired:
                 continue
@@ -235,6 +292,27 @@ class FaultInjector:
                 self.counters["crashes"] += 1
                 raise InjectedCrash(
                     f"injected replica_crash r={f['r']} at step {step}")
+
+    def maybe_kill_leader(self, step: int, is_leader: bool) -> None:
+        """SIGKILL this process when a leader_kill fault matches the step
+        AND this process currently holds leadership (once). Role-
+        addressed: the caller reports its live role each step, so with
+        elections on the victim is whoever holds the lease at that step.
+        SIGKILL on purpose — no atexit, no finally blocks, no final
+        heartbeat: the hardest death the election must recover from."""
+        for i, f in enumerate(self.faults):
+            if f["kind"] != "leader_kill" or ("lkill", i) in self._fired:
+                continue
+            if is_leader and step >= f["step"]:
+                self._fired.add(("lkill", i))
+                self.counters["leader_kills"] += 1
+                import signal
+                import sys
+                print(f"FAULT leader_kill: SIGKILL process "
+                      f"{self.process_index} (leader) at step {step}",
+                      flush=True)
+                sys.stdout.flush()
+                os.kill(os.getpid(), signal.SIGKILL)
 
     def maybe_poison(self, step: int) -> bool:
         """True when a grad_nan fault matches this process and step (once):

@@ -44,8 +44,9 @@ from ps_pytorch_tpu.runtime.coordinator import DistributedKV, KVStore
 from ps_pytorch_tpu.runtime.metrics import MetricsLogger
 from ps_pytorch_tpu.runtime.multislice import make_slice_grad_fn
 from ps_pytorch_tpu.telemetry import (
-    MetricsExporter, Registry, Tracer, declare_training_metrics,
-    device_memory_record, host_rss_bytes, set_default_tracer,
+    MetricsExporter, Registry, Tracer, declare_elastic_metrics,
+    declare_training_metrics, device_memory_record, host_rss_bytes,
+    set_default_tracer,
 )
 
 
@@ -97,6 +98,38 @@ class AsyncTrainer:
         # KV without knowing either layer exists.
         kv, self.injector, self._retrier = resilience.wrap_kv(
             kv, cfg, process_index=self.pid)
+        # Elastic control plane (--elastic): the PS-leader role becomes a
+        # lease over the coordination KV instead of the pid==0 birthright.
+        # The initial leader is --elastic-leader (keep it OFF process 0 in
+        # multi-process runs: process 0 hosts the coordination service, so
+        # killing it in a drill takes the KV down with it). Any follower
+        # that sees the lease go stale campaigns; the winner promotes to
+        # PS duty mid-run (_promote) and the run completes.
+        self.election = None
+        self.membership = None
+        self.announcer = None
+        self.elect_latency_s = 0.0
+        if cfg.elastic:
+            from ps_pytorch_tpu import elastic as elx
+            initial = cfg.elastic_leader % max(self.n, 1)
+            self.leader = self.pid == initial
+            run_id = f"async-{cfg.seed}"
+            lease_s = cfg.leader_lease_s or 1.0
+            self.election = elx.LeaderElection(
+                kv, run_id, self.pid, self.n, interval_s=lease_s,
+                preferred=initial)
+            self.announcer = elx.MemberAnnouncer(
+                kv, run_id, self.pid, [self.pid],
+                interval_s=cfg.heartbeat_interval_s or lease_s)
+            hb_timeout = cfg.heartbeat_timeout_s or 3 * (
+                cfg.heartbeat_interval_s or lease_s)
+            # One "replica" per process in async mode — membership tracks
+            # processes, not data shards (there is no participation mask).
+            self.membership = elx.MembershipRegistry(
+                kv, run_id, self.n, self.n, timeout_s=hb_timeout)
+            if self.leader:
+                self.election.claim_initial()
+            self.announcer.join()
         # Wire format honors the same flags as the in-process aggregator
         # (--compress-grad / --grad-codec): off -> raw npy framing;
         # blosc -> C++ lossless; int8 -> on-device Pallas quantization, the
@@ -158,14 +191,13 @@ class AsyncTrainer:
         # followers to guard). Port is offset by process index so every
         # worker of a local multi-process run gets its own endpoint.
         self.registry = declare_training_metrics(Registry())
+        if cfg.elastic:
+            declare_elastic_metrics(self.registry)
         self.exporter = None
         if cfg.metrics_port > 0:
             self.exporter = MetricsExporter(
                 self.registry, port=cfg.metrics_port + self.pid,
-                health_fn=lambda: {"ok": True, "process_index": self.pid,
-                                   "version": self.version,
-                                   "role": "leader" if self.leader
-                                   else "follower"}).start()
+                health_fn=self._health_status).start()
         self.last_publish_s = 0.0
         self.version = 0        # canonical PS step (leader-owned)
         self.applied = 0
@@ -188,6 +220,15 @@ class AsyncTrainer:
                 lambda p, o, g: apply_optimizer(self.tx, p, o, g),
                 out_shardings=(rep, rep))
 
+    def _health_status(self) -> dict:
+        body = {"ok": True, "process_index": self.pid,
+                "version": self.version, "leader": bool(self.leader),
+                "role": "leader" if self.leader else "follower"}
+        if self.election is not None:
+            body["leader_epoch"] = self.election.epoch
+            body["leader_owner"] = self.election.owner
+        return body
+
     # ---- checkpoint/resume (leader authority, sync-Trainer contract) ----
     def _as_train_state(self):
         from ps_pytorch_tpu.parallel.dp import TrainState
@@ -196,11 +237,18 @@ class AsyncTrainer:
                           batch_stats=self._bs)
 
     def _checkpoint(self) -> None:
+        extra = None
+        if self.election is not None:
+            # Stamp which leadership epoch committed these weights —
+            # serving /healthz surfaces it for the checkpoints it reloads.
+            extra = {"leader_epoch": self.election.epoch,
+                     "leader_pid": self.pid}
         ckpt.save_checkpoint(self.cfg.train_dir, self.version,
                              jax.device_get(self._as_train_state()),
                              config_json=self.cfg.to_json(),
                              compress=self.cfg.compress_grad,
-                             codec_level=self.cfg.codec_level)
+                             codec_level=self.cfg.codec_level,
+                             extra_meta=extra)
         if self.injector is not None:
             self.injector.after_checkpoint(self.cfg.train_dir, self.version)
         if self.cfg.ckpt_keep > 0:
@@ -267,6 +315,79 @@ class AsyncTrainer:
         tpl_leaves, treedef = jax.tree.flatten(self._param_tpl)
         return jax.tree.unflatten(
             treedef, [leaf(e, t) for e, t in zip(wire_leaves, tpl_leaves)])
+
+    # ---- elastic role transitions ----
+    def _promote(self, my_version: int) -> int:
+        """Assume PS duty mid-run after winning an election: build the
+        leader-only machinery this process skipped at startup, recover
+        optimizer state from the latest valid checkpoint (the dead
+        leader's momentum survives through its last save), fast-forward
+        params to the freshest canonical publish on the KV, and announce
+        the takeover with a fresh publish so followers re-anchor."""
+        cfg = self.cfg
+        rep = self._rep
+        self.aggregator = StaleGradientAggregator(
+            self.n, staleness_limit=cfg.staleness_limit,
+            staleness_decay=cfg.staleness_decay,
+            num_aggregate=cfg.num_aggregate, compress=False)
+        self._update = jax.jit(
+            lambda p, o, g: apply_optimizer(self.tx, p, o, g),
+            out_shardings=(rep, rep))
+        self.opt_state = self.tx.init(self.params)
+        self.version = my_version
+        if ckpt.latest_step(cfg.train_dir) is not None:
+            got = ckpt.load_latest_valid(
+                cfg.train_dir, jax.device_get(self._as_train_state()))
+            if got is not None:
+                state, meta, _, _ = got
+                self.opt_state = jax.device_put(state.opt_state, rep)
+                self._bs = jax.device_put(state.batch_stats)
+                if int(meta["step"]) > self.version:
+                    self.params = jax.device_put(state.params, rep)
+                    self.version = int(meta["step"])
+        # The KV canonical publish is usually AHEAD of any checkpoint
+        # (publish_every vs eval_freq); prefer the freshest params even
+        # though the momentum then lags a few steps — async staleness
+        # semantics already tolerate exactly that skew.
+        got = self.transport.fetch_params()
+        if got is not None and got[0] > self.version:
+            self.version = got[0]
+            self.params = jax.device_put(got[1]["params"], self._rep)
+        self.leader = True
+        print(f"ELECTED async leader process {self.pid} epoch "
+              f"{self.election.epoch} at version {self.version} "
+              f"(election {self.elect_latency_s:.3f}s)", flush=True)
+        self._publish_canonical()
+        return self.version
+
+    def _demote(self) -> None:
+        self.leader = False
+        print(f"DEPOSED async leader process {self.pid}: following epoch "
+              f"{self.election.epoch} owner {self.election.owner}",
+              flush=True)
+
+    def _elastic_control(self, own_steps: int, my_version: int) -> int:
+        """One control-plane beat per loop iteration: heartbeat, lease
+        refresh (leader) or staleness check (follower), and the
+        campaign/promote path when the lease goes stale. Returns the
+        version this process should stamp on its next contribution."""
+        from ps_pytorch_tpu.elastic.election import Deposed
+        self.announcer.beat(own_steps)
+        if self.leader:
+            try:
+                self.election.refresh(own_steps)
+                self.membership.update(own_steps)
+            except Deposed:
+                self._demote()
+            return self.version if self.leader else my_version
+        if self.election.check() == "stale":
+            t0 = time.monotonic()
+            won = self.election.campaign()
+            self.elect_latency_s = time.monotonic() - t0
+            self.registry.inc("elections")
+            if won:
+                return self._promote(my_version)
+        return my_version
 
     # ---- the two roles ----
     def _publish_canonical(self) -> None:
@@ -348,7 +469,23 @@ class AsyncTrainer:
         max_own = cfg.max_steps * 50 + 100
         try:
             self._train_loop(cfg, my_version, own_steps, max_own)
+            if self.election is not None:
+                # One parseable control-plane summary per process: the
+                # chaos drill (tools/elastic_drill.py) reads epoch /
+                # world-size / membership-change evidence from here.
+                msnap = self.membership.snapshot()
+                print(f"ELASTIC pid {self.pid} epoch {self.election.epoch} "
+                      f"world {msnap['world_size']} membership_changes "
+                      f"{msnap['membership_changes']} wins "
+                      f"{self.election.stats['wins']}", flush=True)
         finally:
+            if self.announcer is not None:
+                try:
+                    # Graceful leave: the leader evicts on the announcement
+                    # instead of waiting out the heartbeat timeout.
+                    self.announcer.leave()
+                except Exception:
+                    pass  # KV may already be torn down at exit
             # Sinks close on any exit (a follower TimeoutError must not
             # leak the JSONL handle or drop the trace).
             if self.exporter is not None:
@@ -370,6 +507,10 @@ class AsyncTrainer:
                 # Keyed on this process's own step counter (the async loop
                 # has no global step on followers).
                 self.injector.maybe_crash(own_steps + 1)
+                self.injector.maybe_kill_leader(own_steps + 1,
+                                                is_leader=self.leader)
+            if self.election is not None:
+                my_version = self._elastic_control(own_steps, my_version)
             done = self.transport.done()
             if done is not None and (not self.leader):
                 break
@@ -406,6 +547,17 @@ class AsyncTrainer:
                         self.registry.set(k, float(mem[k]))
                 wire = self.transport.wire_stats()
                 extra = {}
+                if self.election is not None:
+                    self.registry.set("leader_epoch",
+                                      float(self.election.epoch))
+                    snap = self.membership.snapshot()
+                    self.registry.set(
+                        "world_size", float(snap["world_size"] or self.n))
+                    delta = snap["membership_changes"] - \
+                        self.registry.get("membership_changes")
+                    if delta > 0:
+                        self.registry.inc("membership_changes", delta)
+                    extra["leader_epoch"] = self.election.epoch
                 if self.injector is not None:
                     extra.update(self.injector.snapshot())
                 if self._retrier is not None:

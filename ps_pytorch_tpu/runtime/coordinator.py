@@ -41,7 +41,10 @@ class LeaderLost(RuntimeError):
 
     Raised from the follower's mask wait so a dead leader surfaces as a
     clear, immediate signal instead of a 300 s TimeoutError with no cause
-    attached (ROADMAP leader-failover item, first step: DETECTION)."""
+    attached. With an election wired (elastic/election.py) this is caught
+    INSIDE participation_mask and answered by a campaign — it only
+    escapes when elections are off or the campaign itself fails
+    (partition), where auto-resume is the escalation."""
 
 
 class KVStore:
@@ -76,6 +79,11 @@ class DistributedKV(KVStore):
         if client is None:
             raise RuntimeError("jax.distributed not initialized")
         self._client = client
+        # jax 0.4.x clients predate key_value_try_get; emulate the
+        # non-blocking read with a directory scan (key_value_dir_get), which
+        # every vintage ships. Control-plane keys are tiny and GC'd (mask
+        # window, per-replica beats), so the scan stays O(few keys).
+        self._has_try_get = hasattr(self._client, "key_value_try_get")
 
     def set(self, key: str, value: str) -> None:
         # Coordination-service keys are write-once by default; control-plane
@@ -83,12 +91,46 @@ class DistributedKV(KVStore):
         self._client.key_value_set(key, value, allow_overwrite=True)
 
     def get(self, key: str, default: Optional[str] = None) -> Optional[str]:
+        if not self._has_try_get:
+            return self._dir_get(key, default)
         try:
             return self._client.key_value_try_get(key)
         except Exception as e:
             # Only "key not published yet" maps to the default; a dead or
             # unreachable coordination service must surface, not be polled.
             if "NOT_FOUND" in str(e):
+                return default
+            raise
+
+    def _dir_get(self, key: str, default: Optional[str]) -> Optional[str]:
+        """try_get emulation: list the key's directory and pick it out. The
+        service reports listed keys with a leading '/', so match both."""
+        prefix = key.rsplit("/", 1)[0] if "/" in key else key
+        try:
+            entries = self._client.key_value_dir_get(prefix)
+        except Exception as e:
+            msg = str(e)
+            if "NOT_FOUND" in msg:
+                return default
+            if "RESOURCE_EXHAUSTED" in msg or "larger than max" in msg:
+                # The directory holds more than one gRPC message of payload
+                # (e.g. wire chunks orphaned by a killed process share the
+                # prefix of a tiny control key). Fetch just the one key with
+                # a short blocking get instead of listing its siblings.
+                return self._blocking_probe(key, default)
+            raise
+        for k, v in entries:
+            if k == key or k == "/" + key:
+                return v
+        return default
+
+    def _blocking_probe(self, key: str, default: Optional[str],
+                        timeout_ms: int = 50) -> Optional[str]:
+        try:
+            return self._client.blocking_key_value_get(key, timeout_ms)
+        except Exception as e:
+            msg = str(e)
+            if "DEADLINE_EXCEEDED" in msg or "NOT_FOUND" in msg:
                 return default
             raise
 
@@ -106,7 +148,8 @@ class Coordinator:
                  kv: Optional[KVStore] = None, run_id: str = "run",
                  leader: bool = True, mask_gc_window: int = 50,
                  liveness=None, lease_interval_s: float = 0.0,
-                 lease_timeout_s: float = 0.0, clock=None):
+                 lease_timeout_s: float = 0.0, clock=None,
+                 election=None, membership=None, liveness_factory=None):
         if mode not in ("sync", "kofn", "async"):
             raise ValueError(f"unknown mode {mode!r}")
         if mode == "kofn" and not (0 < num_aggregate <= n_replicas):
@@ -136,6 +179,17 @@ class Coordinator:
             3.0 * self.lease_interval_s
         self.clock = clock or time.time
         self._lease_last = float("-inf")
+        # Elastic control plane (elastic/): with an election wired,
+        # LeaderLost stops being fatal — the mask wait campaigns instead,
+        # and this Coordinator can PROMOTE itself to leader (or demote on
+        # Deposed fencing) mid-run. membership is the leader-side epoch'd
+        # registry folded into the mask at step boundaries;
+        # liveness_factory builds a LivenessMonitor lazily when a follower
+        # is promoted (followers are constructed without one).
+        self.election = election
+        self.membership = membership
+        self._liveness_factory = liveness_factory
+        self.events: list = []
         self.stats: Dict[str, int] = {"mask_changes": 0}
         # Follower mask-wait backoff (resilience/retry.py): starts at the
         # old 2 ms poll, backs off exponentially to 100 ms, jittered so N
@@ -208,9 +262,75 @@ class Coordinator:
         # the mask-wait — the control-plane stall a straggling leader
         # inflicts on everyone else — and on the leader the decide+publish.
         with _span("coordinator_mask", step=step):
-            if not self.leader:
-                return self._await_mask(key, step, timeout_s)
-            return self._decide_and_publish_mask(key, step)
+            if self.election is None:
+                if not self.leader:
+                    return self._await_mask(key, step, timeout_s)
+                return self._decide_and_publish_mask(key, step)
+            # Elastic: leadership can change hands inside one mask wait.
+            # A deposed leader demotes and falls through to the follower
+            # wait; a follower whose wait raises LeaderLost campaigns and
+            # either promotes (then decides this very mask) or follows the
+            # new winner's lease.
+            from ps_pytorch_tpu.elastic.election import Deposed
+            while True:
+                if self.leader:
+                    try:
+                        return self._decide_and_publish_mask(key, step)
+                    except Deposed:
+                        self._demote(step)
+                        continue
+                try:
+                    return self._await_mask(key, step, timeout_s)
+                except LeaderLost:
+                    self._failover(step)
+
+    # ---- elastic failover (election wired; elastic/election.py) ----
+    def _failover(self, step: int) -> None:
+        """A follower's mask wait saw a stale lease: campaign. Winning
+        promotes this Coordinator to mask authority for the new epoch;
+        losing means a peer claimed a fresh lease and the wait resumes
+        against it. ElectionFailed (no leader after bounded rounds)
+        propagates — that is a partition, and auto-resume's restart path
+        is the right escalation."""
+        self.stats["elections"] = self.stats.get("elections", 0) + 1
+        won = self.election.campaign()
+        self.stats["leader_epoch"] = self.election.epoch
+        if won:
+            self.leader = True
+            self._lease_last = float("-inf")
+            self._last_printed_mask = None  # log the takeover mask
+            if self.liveness is None and self._liveness_factory is not None:
+                self.liveness = self._liveness_factory()
+            print(f"ELECTED leader epoch {self.election.epoch} "
+                  f"at step {step}")
+            self.events.append({"event": "elected",
+                                "epoch": self.election.epoch,
+                                "step": int(step),
+                                "t": round(self.clock(), 3)})
+        else:
+            print(f"FOLLOW leader {self.election.owner} "
+                  f"epoch {self.election.epoch} at step {step}")
+            self.events.append({"event": "follow",
+                                "epoch": self.election.epoch,
+                                "owner": self.election.owner,
+                                "step": int(step),
+                                "t": round(self.clock(), 3)})
+
+    def _demote(self, step: int) -> None:
+        """Epoch fencing fired mid-publish: a higher epoch owns the lease,
+        so this process's mask authority is gone. Its in-flight mask write
+        may have landed, but the new leader re-publishes the same key —
+        last-writer-wins converges on the new epoch's decision."""
+        self.leader = False
+        self.stats["deposed"] = self.stats.get("deposed", 0) + 1
+        self.stats["leader_epoch"] = self.election.epoch
+        print(f"DEPOSED at step {step}: following leader "
+              f"{self.election.owner} epoch {self.election.epoch}")
+        self.events.append({"event": "deposed",
+                            "epoch": self.election.epoch,
+                            "owner": self.election.owner,
+                            "step": int(step),
+                            "t": round(self.clock(), 3)})
 
     def _await_mask(self, key: str, step: int, timeout_s: float) -> np.ndarray:
         """Follower-side mask wait: jittered exponential backoff (the
@@ -251,6 +371,13 @@ class Coordinator:
     def _refresh_lease(self, step: int) -> None:
         """Leader-side: refresh the lease key, throttled to the interval
         (one tiny KV write per interval, rides the mask publish cadence)."""
+        if self.election is not None:
+            # Epoch-fenced lease (elastic/election.py): the refresh itself
+            # verifies ownership unthrottled and raises Deposed when a
+            # higher epoch claimed — the caller (participation_mask)
+            # demotes. The legacy [step, ts] lease key is not written.
+            self.election.refresh(step)
+            return
         if self.lease_interval_s <= 0 or not self.leader:
             return
         now = self.clock()
@@ -264,7 +391,27 @@ class Coordinator:
         stale. A never-published lease is bootstrap grace (the leader may
         not have reached its first publish); transient KV errors are
         absorbed exactly like the mask read itself."""
-        if self.lease_interval_s <= 0 or self.leader:
+        if self.leader:
+            return
+        if self.election is not None:
+            try:
+                status = self.election.check()
+            except Exception as e:
+                from ps_pytorch_tpu.resilience.retry import is_retryable
+                if not is_retryable(e):
+                    raise
+                self.stats["mask_wait_errors"] = \
+                    self.stats.get("mask_wait_errors", 0) + 1
+                return
+            if status == "stale":
+                self.stats["leader_lost"] = \
+                    self.stats.get("leader_lost", 0) + 1
+                raise LeaderLost(
+                    f"leader epoch {self.election.epoch} lease stale "
+                    f"(> {self.election.timeout_s}s) waiting for step "
+                    f"{step}'s mask")
+            return
+        if self.lease_interval_s <= 0:
             return
         try:
             v = self.kv.get(f"{self.run_id}/lease")
@@ -288,6 +435,10 @@ class Coordinator:
 
     def _decide_and_publish_mask(self, key: str, step: int) -> np.ndarray:
         self._refresh_lease(step)
+        if self.membership is not None:
+            # Fold announcements/liveness into the epoch'd view at the
+            # step boundary (publishes {run}/member/view on change).
+            self.membership.update(step)
         mask = self._decide_mask()
         # Observability: one stable line whenever the decision changes (the
         # reference's only straggler evidence was per-worker timing logs).
@@ -315,6 +466,16 @@ class Coordinator:
         # ``_killed`` array alone missed cross-process kills).
         self._refresh_kills()
         mask = (~self._killed).astype(np.float32)
+        if self.membership is not None:
+            # Elastic membership (elastic/membership.py): admissions and
+            # evictions fold in at this step boundary — the registry's own
+            # all-ones degenerate view (nobody announced yet) keeps the
+            # static world intact, and the never-wedge fallbacks below
+            # apply to membership exactly as to liveness.
+            mview = np.asarray(
+                self.membership.mask(), np.float32)[:self.n]
+            if mview.any():
+                mask *= mview
         if self.liveness is not None:
             # Missed-heartbeat eviction (graceful degradation, distinct
             # from kofn slowness); a fully-dead view falls through to the

@@ -221,6 +221,10 @@ class LMTrainer:
     def _health_status(self) -> dict:
         body = self.health.status() if self.health is not None else {"ok": True}
         body["process_index"] = jax.process_index()
+        # Uniform /healthz identity contract with the elastic trainers: the
+        # LM path is pure SPMD (no election), so leadership is static.
+        body["leader"] = jax.process_index() == 0
+        body["role"] = "leader" if body["leader"] else "follower"
         return body
 
     def _ops_step(self, step: int, *, loss=None, step_time=None,

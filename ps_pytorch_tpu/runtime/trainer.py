@@ -109,7 +109,7 @@ class Trainer:
                 from ps_pytorch_tpu.runtime.coordinator import DistributedKV
                 kv = DistributedKV()  # control plane over the coordination service
             elif (self.injector is not None and self.injector.has_kv_faults) \
-                    or cfg.kv_retry_attempts > 1:
+                    or cfg.kv_retry_attempts > 1 or cfg.elastic:
                 # Single-process: materialize the store here so the
                 # resilience shims (fault plane inside, retry plane
                 # outside) wrap the SAME kv the Coordinator uses.
@@ -118,11 +118,38 @@ class Trainer:
             if kv is not None:
                 kv, _, self._retrier = resilience.wrap_kv_with(
                     kv, cfg, self.injector)
+            # Elastic control plane (elastic/): leadership is a LEASE, not
+            # an address. The initial leader is --elastic-leader (keep it
+            # off process 0 on a real fleet: process 0 hosts the
+            # coordination service, so killing it kills the KV itself);
+            # any follower can be promoted mid-run, so everyone gets the
+            # election object and a liveness factory.
+            leader = jax.process_index() == 0
+            election = membership = liveness_factory = None
+            if cfg.elastic:
+                from ps_pytorch_tpu import elastic as elx
+                n_proc = max(jax.process_count(), 1)
+                pid = jax.process_index()
+                initial = cfg.elastic_leader % n_proc
+                leader = pid == initial
+                hb_timeout = cfg.heartbeat_timeout_s or \
+                    3 * (cfg.heartbeat_interval_s or cfg.leader_lease_s)
+                election = elx.LeaderElection(
+                    kv, "run", pid, n_proc,
+                    interval_s=cfg.leader_lease_s, preferred=initial)
+                membership = elx.MembershipRegistry(
+                    kv, "run", n_proc, self.n_data, timeout_s=hb_timeout)
+                liveness_factory = lambda: resilience.LivenessMonitor(  # noqa: E731
+                    kv, "run", self.n_data, timeout_s=hb_timeout)
+                if leader:
+                    election.claim_initial()
             coordinator = Coordinator(
                 self.n_data, mode=cfg.mode, num_aggregate=cfg.num_aggregate,
                 kill_threshold=cfg.kill_threshold, kv=kv,
-                leader=jax.process_index() == 0,
-                lease_interval_s=cfg.leader_lease_s)
+                leader=leader,
+                lease_interval_s=cfg.leader_lease_s,
+                election=election, membership=membership,
+                liveness_factory=liveness_factory)
         self.coordinator = coordinator
         # Data-axis replica indices whose devices live on this host (for
         # duration telemetry feeding the kofn/deadline policies).
@@ -131,17 +158,34 @@ class Trainer:
             if row.flat[0].process_index == jax.process_index()]
         # Liveness: this host beats for its replicas; the leader folds
         # missed beats into the participation mask (crashed != slow).
+        # With --elastic the MemberAnnouncer owns the beat (same hb/ keys,
+        # plus the join announcement the membership registry folds in).
         self.heartbeat = None
-        if cfg.heartbeat_interval_s > 0:
+        self.announcer = None
+        if cfg.elastic and self.coordinator.election is not None:
+            from ps_pytorch_tpu import elastic as elx
+            self.announcer = elx.MemberAnnouncer(
+                self.coordinator.kv, self.coordinator.run_id,
+                jax.process_index(), self._local_replicas,
+                interval_s=cfg.heartbeat_interval_s or cfg.leader_lease_s)
+            self.announcer.join()
+            self.heartbeat = self.announcer.heartbeat
+            from ps_pytorch_tpu.telemetry import declare_elastic_metrics
+            declare_elastic_metrics(self.registry)
+            self._elastic_drained = {"coord": 0, "member": 0, "elect": 0}
+            self._last_member_epoch = 0
+        elif cfg.heartbeat_interval_s > 0:
             self.heartbeat = resilience.Heartbeat(
                 self.coordinator.kv, self.coordinator.run_id,
                 self._local_replicas, interval_s=cfg.heartbeat_interval_s)
-            if self.coordinator.leader and self.coordinator.liveness is None:
-                self.coordinator.liveness = resilience.LivenessMonitor(
-                    self.coordinator.kv, self.coordinator.run_id,
-                    self.n_data,
-                    timeout_s=(cfg.heartbeat_timeout_s
-                               or 3 * cfg.heartbeat_interval_s))
+        if self.heartbeat is not None and self.coordinator.leader and \
+                self.coordinator.liveness is None:
+            self.coordinator.liveness = resilience.LivenessMonitor(
+                self.coordinator.kv, self.coordinator.run_id,
+                self.n_data,
+                timeout_s=(cfg.heartbeat_timeout_s
+                           or 3 * (cfg.heartbeat_interval_s
+                                   or cfg.leader_lease_s)))
         # SIGTERM/preemption: the handler only flags; the loop writes an
         # emergency checkpoint at the next step boundary.
         self._preempt = resilience.PreemptionGuard()
@@ -243,10 +287,17 @@ class Trainer:
                 return
         else:
             state = self.state
+        extra = None
+        if self.coordinator.election is not None:
+            # Stamp which leadership epoch committed these weights —
+            # serving /healthz surfaces it for the checkpoints it reloads.
+            extra = {"leader_epoch": self.coordinator.election.epoch,
+                     "leader_pid": jax.process_index()}
         ckpt.save_checkpoint(self.cfg.train_dir, step, state,
                              config_json=self.cfg.to_json(),
                              compress=self.cfg.compress_grad,
-                             codec_level=self.cfg.codec_level)
+                             codec_level=self.cfg.codec_level,
+                             extra_meta=extra)
         if self.injector is not None:
             # ckpt_corrupt faults strike AFTER the atomic commit — the torn
             # artifact the manifest check must catch, not a failed write.
@@ -264,13 +315,21 @@ class Trainer:
         if self.coordinator.liveness is not None:
             out.update(self.coordinator.liveness.snapshot())
         out["mask_changes"] = self.coordinator.stats.get("mask_changes", 0)
+        if self.coordinator.election is not None:
+            out["leader_epoch"] = self.coordinator.election.epoch
+            out["elections"] = self.coordinator.stats.get("elections", 0)
+        if self.coordinator.membership is not None:
+            m = self.coordinator.membership.snapshot()
+            out["membership_changes"] = m["membership_changes"]
+            out["world_size"] = m["world_size"] or self.n_data
         return out
 
     def _resilience_active(self) -> bool:
         # Gate: vanilla runs keep the exact pre-resilience metrics schema;
         # counters appear only when something resilience-y is configured or
         # the retry plane actually absorbed an error.
-        if self.injector is not None or self.heartbeat is not None:
+        if self.injector is not None or self.heartbeat is not None or \
+                self.cfg.elastic:
             return True
         if self._retrier is not None:
             s = self._retrier.snapshot()
@@ -296,7 +355,58 @@ class Trainer:
         body = self.health.status() if self.health is not None else {"ok": True}
         body["process_index"] = jax.process_index()
         body["run_id"] = self.coordinator.run_id
+        # Leader identity: static role without elections, live epoch'd
+        # identity with them (who leads, which epoch, am I it).
+        body["leader"] = bool(self.coordinator.leader)
+        if self.coordinator.election is not None:
+            body["leader_epoch"] = self.coordinator.election.epoch
+            body["leader_owner"] = self.coordinator.election.owner
         return body
+
+    def _elastic_step(self, step: int) -> None:
+        """Per-step elastic bookkeeping: leader-epoch/world-size gauges,
+        membership-change counter, and election/membership events into the
+        flight recorder. On a membership-epoch change with --shard-update,
+        the new ZeRO shard plan is recomputed and recorded — the
+        rebalancing evidence for post-mortems (elastic/rebalance.py)."""
+        el = self.coordinator.election
+        mem = self.coordinator.membership
+        if el is None:
+            return
+        r = self.registry
+        r.set("leader_epoch", el.epoch)
+        if mem is not None:
+            snap = mem.snapshot()
+            r.set("world_size", snap["world_size"] or self.n_data)
+            delta = snap["membership_changes"] - r.get("membership_changes")
+            if delta > 0:
+                r.inc("membership_changes", delta)
+        e_delta = self.coordinator.stats.get("elections", 0) - \
+            r.get("elections")
+        if e_delta > 0:
+            r.inc("elections", e_delta)
+        if self.flightrec is not None:
+            for src, events in (("coord", self.coordinator.events),
+                                ("member", mem.events if mem else []),
+                                ("elect", el.events)):
+                seen = self._elastic_drained[src]
+                for ev in events[seen:]:
+                    kind = "membership" if src == "member" else "election"
+                    self.flightrec.record_event(kind, dict(ev))
+                self._elastic_drained[src] = len(events)
+        if mem is not None and mem.epoch != self._last_member_epoch:
+            self._last_member_epoch = mem.epoch
+            if self.cfg.shard_update and mem.members:
+                from ps_pytorch_tpu.elastic import plan_shards
+                size = sum(int(np.prod(l.shape)) for l in
+                           jax.tree.leaves(self.state.params))
+                plan = plan_shards(size, len(mem.members))
+                print(f"REBALANCE shard plan epoch {mem.epoch}: "
+                      f"{plan.n} shards x {plan.chunk} params")
+                if self.flightrec is not None:
+                    self.flightrec.record_event("shard_replan", {
+                        "epoch": mem.epoch, "n_shards": plan.n,
+                        "chunk": plan.chunk, "step": step})
 
     def _ops_step(self, step: int, *, loss=None, grad_norm=None,
                   nonfinite=None, step_time=None, data_time=None) -> None:
@@ -319,6 +429,8 @@ class Trainer:
         if data_time is not None:
             r.set("train_data_time_s", data_time)
         self._update_memory_gauges()
+        if self.cfg.elastic:
+            self._elastic_step(step)
         if self.flightrec is not None:
             self.flightrec.record_step(step, loss=loss, grad_norm=grad_norm,
                                        step_time=step_time,
@@ -382,6 +494,13 @@ class Trainer:
                     x, y = self.train_loader.next_batch()
                 t_data = time.monotonic() - t0
                 mask = self.coordinator.participation_mask(step)
+                if self.injector is not None:
+                    # Role-addressed kill AFTER the mask decision: the
+                    # leader dies with this step's mask already published,
+                    # the worst-case handoff (followers consume it, then
+                    # find the lease stale at step+1 and elect).
+                    self.injector.maybe_kill_leader(
+                        step, is_leader=self.coordinator.leader)
                 if self.injector is not None and \
                         self.injector.maybe_poison(step):
                     # grad_nan fault: NaN rides the mask into the step's

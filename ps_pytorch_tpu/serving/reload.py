@@ -45,6 +45,10 @@ class CheckpointWatcher:
         self.reloads = 0
         self.skipped_corrupt = 0
         self.poll_count = 0
+        # Meta of the newest loaded checkpoint — elastic training runs
+        # stamp leader_epoch/leader_pid here, and /healthz surfaces which
+        # leadership epoch produced the weights currently being served.
+        self.last_meta: dict = {}
 
     def poll(self) -> Optional[ReloadResult]:
         """None when nothing newer is loadable; otherwise load the newest
@@ -68,5 +72,6 @@ class CheckpointWatcher:
             return None     # newest valid is what we already serve
         self.loaded_step = step
         self.reloads += 1
+        self.last_meta = dict(meta)
         return ReloadResult(step=step, params=self.to_tree(state.params),
                             config_json=config_json, meta=meta)

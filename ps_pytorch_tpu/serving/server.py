@@ -12,7 +12,9 @@ API (JSON in, JSON out):
   "ttft_ms", "latency_ms", "model_step", "rid"}``; 400 invalid request;
   503 queue full (backpressure); 504 deadline shed or timeout.
 - ``GET /healthz``        liveness + slot/queue occupancy (+ watchdog state
-  when the frontend was built with a ``HealthMonitor``). Always HTTP 200 —
+  when the frontend was built with a ``HealthMonitor``; + leader identity
+  fields — ``leader``/``leader_epoch``/``leader_pid`` — when the served
+  checkpoints come from an elastic training run). Always HTTP 200 —
   orchestration liveness probes key on the ``ok`` field, not the status.
 - ``GET /stats``          engine/queue counters (+ registry snapshot).
 - ``GET /metrics``        Prometheus text exposition of the engine registry
@@ -44,9 +46,13 @@ class ServingFrontend:
                  host: str = "127.0.0.1", port: int = 0,
                  max_queue: int = 64, reload_s: float = 10.0,
                  default_deadline_s: float = 30.0,
-                 default_n_new: int = 128, health=None):
+                 default_n_new: int = 128, health=None, identity=None):
         self.engine = engine
         self.health = health
+        # Static identity fields merged into /healthz (leader/role/epoch of
+        # the training run that produced the served weights); checkpoint
+        # reloads refresh the epoch from the new checkpoint's meta.
+        self.identity = dict(identity or {})
         self.queue = AdmissionQueue(max_queue, clock=engine.clock,
                                     registry=engine.registry)
         self.watcher = watcher
@@ -202,6 +208,12 @@ class _Handler(BaseHTTPRequestHandler):
             out = {"ok": True, "slots_free": e.free_slots,
                    "queue_depth": self.fe.queue.depth(),
                    "model_step": e.model_step}
+            out.update(self.fe.identity)
+            w = self.fe.watcher
+            if w is not None and getattr(w, "last_meta", None):
+                for k in ("leader_epoch", "leader_pid"):
+                    if k in w.last_meta:
+                        out[k] = w.last_meta[k]
             if self.fe.health is not None:
                 out["health"] = self.fe.health.status()
                 out["ok"] = bool(out["health"]["ok"])

@@ -210,6 +210,33 @@ def declare_resilience_metrics(registry: Registry) -> Registry:
     return registry
 
 
+# ---- elastic control-plane contract (ps_pytorch_tpu/elastic/) ----
+#
+# Same discipline: the reviewable list of what the election/membership
+# planes surface. leader_epoch and world_size are GAUGES (the epoch is
+# monotonic but a freshly-promoted process starts from the observed value,
+# not zero); membership_changes/elections are cumulative counters, so the
+# Prometheus exposition renders them with the _total suffix.
+ELASTIC_COUNTERS = (
+    ("membership_changes", "events",
+     "membership-epoch bumps (joins, leaves, evictions folded in)"),
+    ("elections", "events", "leader campaigns run after a stale lease"),
+)
+ELASTIC_GAUGES = (
+    ("leader_epoch", "epoch", "current leader-lease epoch"),
+    ("world_size", "processes", "active members in the current view"),
+)
+
+
+def declare_elastic_metrics(registry: Registry) -> Registry:
+    """Declare the elastic counters/gauges on ``registry``."""
+    for name, unit, help_ in ELASTIC_COUNTERS:
+        registry.counter(name, unit=unit, help=help_)
+    for name, unit, help_ in ELASTIC_GAUGES:
+        registry.gauge(name, unit=unit, help=help_)
+    return registry
+
+
 # ---- serving metric contract (ps_pytorch_tpu/serving/) ----
 #
 # Same discipline as RESILIENCE_COUNTERS: the one reviewable list of what

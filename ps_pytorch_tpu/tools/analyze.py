@@ -51,6 +51,13 @@ wire_read via the correlation id transport.py stamps on both legs:
 
     python -m ps_pytorch_tpu.tools.analyze flight ./train_dir/flightrec.json
     python -m ps_pytorch_tpu.tools.analyze stitch 'trace.json*' --out all.json
+
+Membership mode reads the same flight dumps from an elastic run
+(``--elastic``) and renders the control-plane history as one epoch
+timeline — elections won/lost, joins/leaves/evictions, shard replans —
+merged chronologically across every process's dump:
+
+    python -m ps_pytorch_tpu.tools.analyze membership 'run/flightrec.json*'
 """
 
 import argparse
@@ -526,6 +533,69 @@ def flight_main(args, parser) -> int:
     return 0
 
 
+# ---- membership mode (elastic epoch timeline from flight dumps) ----
+
+def membership_timeline(docs: List[dict]) -> tuple:
+    """Flight-recorder docs -> (chronological control-plane timeline,
+    summary). The elastic trainers drain election/membership/shard_replan
+    events into the flight recorder (runtime/trainer.py ``_elastic_step``);
+    this folds the dumps of every process back into one epoch history:
+    who led which epoch, who joined/left/was evicted when, and where the
+    shard plan was recomputed."""
+    rows: List[dict] = []
+    for doc in docs:
+        for ev in doc.get("events", []):
+            if ev.get("kind") in ("election", "membership", "shard_replan"):
+                rows.append(dict(ev))
+    if not rows:
+        raise ValueError("no election/membership events")
+    rows.sort(key=lambda e: float(e.get("t", 0)))
+    counts: Dict[str, int] = {}
+    epochs = set()
+    for ev in rows:
+        counts[ev.get("event", ev["kind"])] = \
+            counts.get(ev.get("event", ev["kind"]), 0) + 1
+        if "epoch" in ev:
+            epochs.add(int(ev["epoch"]))
+    summary = {"events": len(rows), "counts": counts,
+               "epochs": sorted(epochs),
+               "max_epoch": max(epochs) if epochs else 0}
+    return rows, summary
+
+
+def membership_markdown(rows: List[dict], summary: dict) -> str:
+    t0 = float(rows[0].get("t", 0))
+    lines = ["| t+s | kind | event | pid | epoch | step |",
+             "|---|---|---|---|---|---|"]
+    for ev in rows:
+        lines.append(
+            f"| {float(ev.get('t', t0)) - t0:+.3f} | {ev['kind']} "
+            f"| {ev.get('event', '')} | {ev.get('pid', '')} "
+            f"| {ev.get('epoch', '')} | {ev.get('step', '')} |")
+    c = ", ".join(f"{k}={v}" for k, v in sorted(summary["counts"].items()))
+    lines.append(f"\n{summary['events']} events ({c}); epochs "
+                 f"{summary['epochs']} (max {summary['max_epoch']})")
+    return "\n".join(lines)
+
+
+def membership_main(args, parser) -> int:
+    from ps_pytorch_tpu.telemetry.flightrec import load_flight
+    files: List[str] = []
+    for pattern in args.runs:
+        files.extend(sorted(glob.glob(pattern)) or
+                     parser.error(f"no files match {pattern!r}") or [])
+    docs = [load_flight(path) for path in files]
+    try:
+        rows, summary = membership_timeline(docs)
+    except ValueError as e:
+        parser.error(f"{e} in {files}")
+    if args.json:
+        print(json.dumps({"timeline": rows, "summary": summary}))
+    else:
+        print(membership_markdown(rows, summary))
+    return 0
+
+
 # ---- stitch mode (cross-process trace merge with wire flow events) ----
 
 def stitch_chrome_traces(docs: List[dict]) -> tuple:
@@ -628,6 +698,9 @@ def main(argv=None) -> int:
     if args.runs[0] == "serving":
         args.runs = args.runs[1:] or p.error("serving mode needs FILE...")
         return serving_main(args, p)
+    if args.runs[0] == "membership":
+        args.runs = args.runs[1:] or p.error("membership mode needs FILE...")
+        return membership_main(args, p)
 
     runs: Dict[str, List[str]] = {}
     for spec in args.runs:

@@ -16,6 +16,10 @@ Families and their watched metrics (direction, relative tolerance):
                                         budget, not a relative drift)
 - ``resilience`` RESILIENCE_r*.json     boolean invariants must stay true
                                         (bitwise_equal/ok) and kv_giveups 0
+- ``elastic``    RESILIENCE_r*.json     newest artifact WITH an "elastic"
+                                        section: >=1 election, >=1
+                                        membership change, final epoch >=2,
+                                        ok true, kv_giveups 0
 
 Rows are matched by their "config" name — a config present in the baseline
 but missing from the candidate is a failure (silently dropping a bench row
@@ -66,6 +70,19 @@ FAMILIES: Dict[str, dict] = {
         "metrics": [],              # invariant check, see _check_resilience
         "bools": ["bitwise_equal", "ok"],
         "zero_counters": ["kv_giveups"],
+    },
+    "elastic": {
+        # Same artifact series as resilience, but gating the elastic
+        # control-plane drill: the newest RESILIENCE_r*.json carrying an
+        # "elastic" section must show at least one real election and one
+        # membership change (a drill where nobody died proved nothing),
+        # with the run still ok and the retry plane never giving up.
+        "pattern": "RESILIENCE_r[0-9]*.json",
+        "metrics": [],              # invariant check, see _check_elastic
+        "bools": ["bitwise_equal", "ok"],
+        "zero_counters": ["kv_giveups"],
+        "min_elastic": [("elections", 1), ("membership_changes", 1),
+                        ("final_epoch", 2)],
     },
 }
 
@@ -120,6 +137,8 @@ def compare(family: str, baseline, candidate) -> dict:
     spec = FAMILIES[family]
     if family == "resilience":
         return _check_resilience(spec, candidate)
+    if family == "elastic":
+        return _check_elastic(spec, candidate)
     if family == "ops":
         return _check_ops(spec, candidate)
     base_rows, cand_rows = _by_config(baseline), _by_config(candidate)
@@ -187,6 +206,35 @@ def _check_resilience(spec: dict, candidate) -> dict:
             "configs": {"invariants": {"ok": ok, "metrics": checks}}}
 
 
+def _check_elastic(spec: dict, candidate) -> dict:
+    doc = candidate if isinstance(candidate, dict) else \
+        (candidate[0] if candidate else {})
+    checks: Dict[str, dict] = {}
+    ok = True
+    elastic = doc.get("elastic")
+    if not isinstance(elastic, dict):
+        return {"family": "elastic", "ok": False,
+                "configs": {"invariants": {"ok": False, "metrics": {
+                    "_elastic": {"ok": False,
+                                 "note": "artifact has no elastic "
+                                         "section"}}}}}
+    for key in spec["bools"]:
+        if key in doc:
+            checks[key] = {"cand": doc[key], "ok": bool(doc[key])}
+            ok = ok and checks[key]["ok"]
+    counters = doc.get("counters", {})
+    for key in spec["zero_counters"]:
+        if key in counters:
+            checks[key] = {"cand": counters[key], "ok": counters[key] == 0}
+            ok = ok and checks[key]["ok"]
+    for key, floor in spec["min_elastic"]:
+        val = int(elastic.get(key, 0))
+        checks[key] = {"cand": val, "floor": floor, "ok": val >= floor}
+        ok = ok and checks[key]["ok"]
+    return {"family": "elastic", "ok": ok,
+            "configs": {"invariants": {"ok": ok, "metrics": checks}}}
+
+
 def run_gate(family: str, candidate_path: str, repo: str = ".",
              baseline_path: str = "") -> dict:
     """Gate one candidate artifact against the newest committed baseline
@@ -226,7 +274,18 @@ def run_all(repo: str = ".") -> dict:
             families[family] = {"family": family, "ok": True,
                                 "note": "no committed artifacts; skipped"}
             continue
-        if family in ("resilience", "ops"):
+        if family == "elastic":
+            # Gate the newest artifact that actually ran the elastic drill
+            # (older RESILIENCE rounds predate the subsystem).
+            with_section = [p for p in paths if isinstance(
+                load_artifact(p), dict) and "elastic" in load_artifact(p)]
+            if not with_section:
+                families[family] = {"family": family, "ok": True,
+                                    "note": "no artifact with an elastic "
+                                            "section; skipped"}
+                continue
+            families[family] = run_gate(family, with_section[-1], repo=repo)
+        elif family in ("resilience", "ops"):
             families[family] = run_gate(family, paths[-1], repo=repo)
         elif len(paths) < 2:
             families[family] = {"family": family, "ok": True,

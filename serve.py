@@ -68,7 +68,7 @@ def main(argv=None) -> int:
                                  migrate=migrate_packed_qkv)
     if got is None:
         p.error(f"no restorable checkpoint in {args.train_dir}")
-    state, _, _, step = got
+    state, meta, _, step = got
 
     geo = lm_geometry(cfg)
     registry = Registry()
@@ -89,11 +89,18 @@ def main(argv=None) -> int:
     # state shows up under /healthz's "health" key.
     health = HealthMonitor(args.health_spec or "stall:warn",
                            registry=registry)
+    # Identity fields for /healthz: elastic training runs stamp which
+    # leadership epoch committed each checkpoint (extra_meta); the serving
+    # process itself is a single-process "leader" of its own plane.
+    identity = {"leader": True, "role": "serving"}
+    for k in ("leader_epoch", "leader_pid"):
+        if k in meta:
+            identity[k] = meta[k]
     frontend = ServingFrontend(
         engine, watcher=watcher, host=args.serve_host, port=args.serve_port,
         max_queue=args.serve_max_queue, reload_s=args.serve_reload_s,
         default_deadline_s=args.serve_deadline_s,
-        default_n_new=args.serve_max_new, health=health)
+        default_n_new=args.serve_max_new, health=health, identity=identity)
     frontend.start()
     print(json.dumps({"serving": f"http://{args.serve_host}:{frontend.port}",
                       "metrics": f"http://{args.serve_host}:{frontend.port}"

@@ -624,3 +624,101 @@ def test_leader_lease_survives_kv_chaos_then_detects_death():
     with pytest.raises(LeaderLost):
         follower.participation_mask(3, timeout_s=60.0)
     assert inj.snapshot()["kv_drops"] > 0
+
+
+def test_lease_throttle_state_does_not_leak_across_epochs():
+    """ISSUE 7 edge case: a deposed leader's refresh throttle (``_last``)
+    must be RESET when it wins a later epoch. The claim write IS the new
+    epoch's first refresh — an inherited ``_last`` would either suppress
+    that first refresh (recent ``_last``) or double-write it (ancient
+    ``_last``), and followers would see a lease whose cadence belongs to
+    the dead epoch."""
+    from ps_pytorch_tpu.elastic import Deposed, LeaderElection
+    clock, kv = ManualClock(), KVStore()
+
+    def make(pid):
+        return LeaderElection(kv, "run", pid, 2, interval_s=1.0,
+                              settle_s=0.0, preferred=0, clock=clock.time,
+                              sleep=lambda s: None)
+
+    el = make(0)
+    el.claim_initial()                      # epoch 1, _last = 0.0
+    assert el._last == 0.0
+    # A usurper claims epoch 2 while el is stalled; el's next refresh
+    # hits the fence and demotes — but its old throttle state survives.
+    clock.now = 0.5
+    kv.set("run/elect/lease", json.dumps([2, 1, clock.time()]))
+    with pytest.raises(Deposed):
+        el.refresh()
+    # The usurper dies too; el campaigns at T and wins epoch 3.
+    clock.now = 10.5
+    assert el.campaign() is True
+    assert el.epoch == 3 and el.is_leader
+    # The claim reset the throttle to the claim time, NOT a value carried
+    # over from epoch 1.
+    assert el._last == 10.5
+    # Claim counts as the epoch's first refresh: within the interval the
+    # refresh is throttled (no redundant write)...
+    clock.now = 10.5 + 0.9
+    assert el.refresh() is False
+    # ...and at the interval boundary the cadence resumes normally.
+    clock.now = 10.5 + 1.0
+    assert el.refresh() is True
+    assert json.loads(kv.get("run/elect/lease")) == [3, 0, 11.5]
+    # A follower sees a FRESH epoch-3 lease owned by the re-elected 0.
+    follower = make(1)
+    assert follower.check() == "fresh"
+    assert (follower.epoch, follower.owner) == (3, 0)
+
+
+def test_dir_get_falls_back_to_blocking_probe_on_oversized_dir():
+    # A killed process can orphan megabytes of wire chunks under the run
+    # prefix; the try_get emulation's directory scan then exceeds the gRPC
+    # message cap. The KV must fall back to a single-key blocking get
+    # instead of surfacing RESOURCE_EXHAUSTED to the retry layer.
+    from ps_pytorch_tpu.runtime.coordinator import DistributedKV
+
+    class FakeClient:
+        def __init__(self):
+            self.store = {}
+            self.dir_calls = 0
+            self.probe_calls = 0
+
+        def key_value_dir_get(self, prefix):
+            self.dir_calls += 1
+            raise RuntimeError(
+                "RESOURCE_EXHAUSTED: Received message larger than max "
+                "(10787499 vs. 4194304)")
+
+        def blocking_key_value_get(self, key, timeout_in_ms):
+            self.probe_calls += 1
+            if key in self.store:
+                return self.store[key]
+            raise RuntimeError("DEADLINE_EXCEEDED: timed out")
+
+    kv = DistributedKV.__new__(DistributedKV)
+    kv._client = FakeClient()
+    kv._has_try_get = False
+
+    # Absent key -> default, via the probe (deadline maps to default).
+    assert kv.get("run/adone", None) is None
+    kv._client.store["run/adone"] = "1"
+    assert kv.get("run/adone") == "1"
+    assert kv._client.dir_calls == 2 and kv._client.probe_calls == 2
+
+
+def test_dir_get_oversized_fallback_reraises_other_errors():
+    from ps_pytorch_tpu.runtime.coordinator import DistributedKV
+
+    class FakeClient:
+        def key_value_dir_get(self, prefix):
+            raise RuntimeError("RESOURCE_EXHAUSTED: larger than max")
+
+        def blocking_key_value_get(self, key, timeout_in_ms):
+            raise RuntimeError("UNAVAILABLE: coordination service down")
+
+    kv = DistributedKV.__new__(DistributedKV)
+    kv._client = FakeClient()
+    kv._has_try_get = False
+    with pytest.raises(RuntimeError, match="UNAVAILABLE"):
+        kv.get("run/adone")
