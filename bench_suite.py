@@ -493,6 +493,124 @@ def bench_serving(name, steps, *, slots, n_req=8, prompt_len=32, n_new=64,
             "tokens_sha256": sha}
 
 
+def bench_slo_sweep(name, steps, *, slots=4, n_req=10, prompt_len=16,
+                    n_new=24, d_model=64, n_layers=2, n_heads=2, vocab=128,
+                    seq_len=64,
+                    slo_spec="ttft_p99<30s;latency_p99<60s;"
+                             "availability>=99",
+                    rates=(1.0, 2.0, 4.0, 8.0)):
+    """Goodput-under-SLO harness row (ISSUE 8): a rising-offered-load
+    Poisson ladder through the open-loop path (AdmissionQueue +
+    serve_loop), each rung judged against ``slo_spec`` offline; the KNEE
+    is the highest compliant arrival rate and goodput-under-SLO is the
+    knee rung's tokens/sec — the row's headline. ``knee_bar`` is the
+    lowest offered rate: the engine failing its (deliberately loose) SLO
+    even there is a regression, and tools/regress.py's slo family gates
+    ``knee_rps >= knee_bar``. ``steps`` is unused (each rung is one
+    open-loop run; its length is n_req/rate)."""
+    from ps_pytorch_tpu.models.transformer import TransformerLM
+    from ps_pytorch_tpu.serving.engine import ServingEngine
+    from ps_pytorch_tpu.serving.loadgen import (
+        make_requests, run_closed_loop, run_slo_sweep,
+    )
+
+    model = TransformerLM(vocab_size=vocab, d_model=d_model,
+                          n_layers=n_layers, n_heads=n_heads,
+                          max_seq_len=seq_len)
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, prompt_len), jnp.int32),
+                        positions=jnp.arange(prompt_len))["params"]
+    engine = ServingEngine(params, slots=slots, vocab=vocab,
+                           d_model=d_model, n_layers=n_layers,
+                           n_heads=n_heads, max_seq_len=seq_len)
+    # Warm the jit cache (prefill/step/sampler) so rung 0 doesn't pay
+    # compile time inside its TTFT percentiles.
+    run_closed_loop(engine, make_requests(
+        min(slots, 2), prompt_len=prompt_len, n_new=4, vocab=vocab,
+        seed=9999))
+    sweep = run_slo_sweep(engine, slo_spec, rates=rates, n_req=n_req,
+                          prompt_len=prompt_len, n_new=n_new, seed=321)
+    knee_bar = min(rates)
+    ladder = [{k: r.get(k) for k in
+               ("rate_rps", "completed", "shed", "rejected", "failed",
+                "tokens_per_sec", "ttft_p99_ms", "latency_p99_ms",
+                "availability")} | {"compliant": r["slo"]["compliant"]}
+              for r in sweep["ladder"]]
+    return {"config": name, "platform": jax.devices()[0].platform,
+            "slots": slots, "n_req_per_rung": n_req, "n_new": n_new,
+            "slo_spec": slo_spec, "ladder": ladder,
+            "knee_rps": sweep["knee_rps"],
+            "goodput_under_slo_tps": sweep["goodput_under_slo_tps"],
+            "knee_bar": knee_bar,
+            "ok": bool(sweep["ok"] and sweep["knee_rps"] is not None
+                       and sweep["knee_rps"] >= knee_bar)}
+
+
+def bench_reqtrace_overhead(name, steps, *, reps=3, slots=8, n_req=8,
+                            prompt_len=32, n_new=64, d_model=128,
+                            n_layers=2, n_heads=4, vocab=256, seq_len=256):
+    """Request-observability cost row: the serve_batched_8 workload drained
+    closed-loop through a bare engine vs one carrying the FULL request
+    plane — declared serving registry, RequestTraceLog ring, and an
+    SLOTracker fed by every terminal request. min-of-reps both sides;
+    ``ok`` needs the <2% budget AND bitwise-identical sampled tokens (the
+    plane is host-side by contract — a tracer that perturbs sampling is
+    broken, not slow)."""
+    import hashlib
+
+    from ps_pytorch_tpu.models.transformer import TransformerLM
+    from ps_pytorch_tpu.serving.engine import ServingEngine
+    from ps_pytorch_tpu.serving.loadgen import make_requests, run_closed_loop
+    from ps_pytorch_tpu.serving.reqtrace import RequestTraceLog
+    from ps_pytorch_tpu.telemetry import Registry, declare_serving_metrics
+    from ps_pytorch_tpu.telemetry.slo import SLOTracker
+
+    model = TransformerLM(vocab_size=vocab, d_model=d_model,
+                          n_layers=n_layers, n_heads=n_heads,
+                          max_seq_len=seq_len)
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, prompt_len), jnp.int32),
+                        positions=jnp.arange(prompt_len))["params"]
+
+    def run(traced):
+        kw = {}
+        if traced:
+            registry = declare_serving_metrics(Registry())
+            kw = dict(registry=registry,
+                      reqtrace=RequestTraceLog(256, sample=0.05),
+                      slo=SLOTracker("ttft_p99<30s;latency_p99<60s;"
+                                     "availability>=99", registry=registry))
+        engine = ServingEngine(params, slots=slots, vocab=vocab,
+                               d_model=d_model, n_layers=n_layers,
+                               n_heads=n_heads, max_seq_len=seq_len, **kw)
+        run_closed_loop(engine, make_requests(
+            min(slots, 2), prompt_len=prompt_len, n_new=4, vocab=vocab,
+            seed=9999))
+        best, sha = None, None
+        for _ in range(reps):
+            reqs = make_requests(n_req, prompt_len=prompt_len, n_new=n_new,
+                                 vocab=vocab, seed=123)
+            stats = run_closed_loop(engine, reqs)
+            if best is None or stats["wall_s"] < best:
+                best = stats["wall_s"]
+            if sha is None:
+                sha = hashlib.sha256(json.dumps(
+                    [r.tokens for r in reqs]).encode()).hexdigest()
+        return best, sha
+
+    baseline_s, sha_bare = run(False)
+    traced_s, sha_traced = run(True)
+    frac = (traced_s - baseline_s) / baseline_s
+    bitwise = sha_bare == sha_traced
+    return {"config": name, "platform": jax.devices()[0].platform,
+            "slots": slots, "n_req": n_req, "n_new": n_new, "reps": reps,
+            "baseline_s": round(baseline_s, 5),
+            "traced_s": round(traced_s, 5),
+            "overhead_frac": round(frac, 5),
+            "bitwise_identical": bitwise,
+            "ok": bool(bitwise and frac < 0.02)}
+
+
 def bench_pallas_conv_ab(name, steps, *, batch=1024, hw=32, c=64):
     """A/B: Pallas 3x3 conv prototype vs lax.conv on the trace's hot
     geometry (PERF.md §7: 32x32/64-ch blocks HBM-bound at ~486 GB/s, the
@@ -995,6 +1113,13 @@ CONFIGS = {
         "serve_sequential_8", steps, slots=1),
     "serve_batched_8": lambda steps: bench_serving(
         "serve_batched_8", steps, slots=8),
+    # -- request-scoped observability (ISSUE 8): the SLO ladder (knee +
+    # goodput-under-SLO headline) and the reqtrace+SLO plane's cost on the
+    # serve_batched_8 workload; both feed SLO_r*.json, gated by regress.py's
+    # slo family. --
+    "slo_sweep": lambda steps: bench_slo_sweep("slo_sweep", steps),
+    "serve_reqtrace_overhead": lambda steps: bench_reqtrace_overhead(
+        "serve_reqtrace_overhead", steps),
     # -- live ops plane (ISSUE 6): exporter + watchdogs + flight recorder
     # cost on the bare step loop; the row asserts the <2% budget that
     # tools/regress.py's ops family gates. --

@@ -135,6 +135,9 @@ class TrainConfig:
     serve_host: str = "127.0.0.1"
     serve_deadline_s: float = 30.0   # default per-request deadline; queued past it -> shed (504)
     serve_max_new: int = 128         # default n_new when the request doesn't set one
+    slo_spec: str = ""               # serving SLO objectives, e.g. "ttft_p99<100ms;latency_p99<2s;availability>=99.5" (telemetry/slo.py grammar; "" = no SLO tracking)
+    reqtrace_keep: int = 256         # request-trace ring capacity; 0 = per-request lifecycle tracing off
+    reqtrace_sample: float = 0.05    # fraction of fast `done` requests kept (slow tail + non-done outcomes are always kept)
 
     # -- logging / profiling / telemetry --
     log_every: int = 1
@@ -240,6 +243,16 @@ class TrainConfig:
         if self.serve_port < 0:
             raise ValueError(f"serve_port={self.serve_port} "
                              "(must be >= 0; 0 = ephemeral)")
+        if self.slo_spec:
+            # Config-time validation, same family as fault_spec/health_spec.
+            from ps_pytorch_tpu.telemetry.slo import parse_slo_spec
+            parse_slo_spec(self.slo_spec)
+        if self.reqtrace_keep < 0:
+            raise ValueError(f"reqtrace_keep={self.reqtrace_keep} "
+                             "(must be >= 0; 0 = tracing off)")
+        if not 0.0 <= self.reqtrace_sample <= 1.0:
+            raise ValueError(f"reqtrace_sample={self.reqtrace_sample} "
+                             "(must be in [0, 1])")
         if self.mode == "async" and self.publish_every > max(self.staleness_limit, 1):
             # Followers only ever see published versions: a publish gap
             # wider than the staleness window makes EVERY follower gradient

@@ -14,15 +14,24 @@ The pieces compose bottom-up and each is usable alone:
 - ``server``   stdlib ``ThreadingHTTPServer`` JSON front-end
                (``ServingFrontend``) — no new dependencies.
 - ``loadgen``  closed/open-loop synthetic load generation reporting
-               TTFT / p50 / p99 / tokens-per-sec.
+               TTFT / p50 / p99 / tokens-per-sec, plus the SLO sweep
+               ladder (``run_slo_sweep``: knee + goodput-under-SLO).
+- ``reqtrace`` per-request lifecycle traces in a tail-sampled bounded ring
+               (``RequestTraceLog``) — the /debug/requests body and the
+               Chrome spans `analyze.py stitch` joins to engine spans.
 
 Entry point: ``serve.py`` at the repo root (flags in ``config.py``:
-``--serve-slots`` / ``--serve-max-queue`` / ``--serve-reload-s`` ...).
+``--serve-slots`` / ``--serve-max-queue`` / ``--serve-reload-s`` /
+``--slo-spec`` / ``--reqtrace-keep`` ...).
 """
 
 from ps_pytorch_tpu.serving.engine import Request, ServingEngine, serve_loop
 from ps_pytorch_tpu.serving.queue import AdmissionQueue
 from ps_pytorch_tpu.serving.reload import CheckpointWatcher
+from ps_pytorch_tpu.serving.reqtrace import (RequestTrace, RequestTraceLog,
+                                             record_terminal,
+                                             trace_from_request)
 
 __all__ = ["Request", "ServingEngine", "serve_loop", "AdmissionQueue",
-           "CheckpointWatcher"]
+           "CheckpointWatcher", "RequestTrace", "RequestTraceLog",
+           "record_terminal", "trace_from_request"]

@@ -42,6 +42,7 @@ import numpy as np
 
 from ps_pytorch_tpu.models.generate import _sample
 from ps_pytorch_tpu.models.transformer import TransformerLM
+from ps_pytorch_tpu.serving.reqtrace import corr_id, record_terminal
 from ps_pytorch_tpu.telemetry.trace import span as _span
 
 
@@ -69,9 +70,12 @@ class Request:
     error: str = ""
     model_step: Optional[int] = None   # checkpoint step that admitted it
     t_submit: float = 0.0
+    t_enqueue: float = 0.0   # entered the admission queue
     t_admit: float = 0.0
     t_first: float = 0.0     # first token available (TTFT reference point)
+    t_last: float = 0.0      # last token sampled
     t_done: float = 0.0
+    tick_t: List[float] = field(default_factory=list)  # per-token sample times
 
     def __post_init__(self):
         self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
@@ -96,12 +100,16 @@ class ServingEngine:
     ``cache_len`` bounds prompt+generation per request (defaults to
     ``max_seq_len``, the positional table's length). ``registry`` is an
     optional telemetry Registry with the serving metrics declared
-    (telemetry/registry.declare_serving_metrics)."""
+    (telemetry/registry.declare_serving_metrics); ``reqtrace`` an optional
+    serving.reqtrace.RequestTraceLog and ``slo`` an optional
+    telemetry.slo.SLOTracker — both are fed one record per terminal
+    request, host-side only, never touching the sampling chain."""
 
     def __init__(self, params, *, slots: int, vocab: int, d_model: int,
                  n_layers: int, n_heads: int, max_seq_len: int,
                  cache_len: int = 0, dtype: Any = jnp.float32,
                  model_step: Optional[int] = None, registry=None,
+                 reqtrace=None, slo=None,
                  clock: Callable[[], float] = time.monotonic):
         if slots < 1:
             raise ValueError(f"slots={slots} (need >= 1)")
@@ -115,6 +123,8 @@ class ServingEngine:
         self.cache_len = cache_len
         self.model_step = model_step
         self.registry = registry
+        self.reqtrace = reqtrace
+        self.slo = slo
         self.clock = clock
         self.model = TransformerLM(vocab_size=vocab, d_model=d_model,
                                    n_layers=n_layers, n_heads=n_heads,
@@ -197,8 +207,11 @@ class ServingEngine:
         req._key, sub = jax.random.split(req._key)
         tok = int(self._sampler(req.temperature, req.top_k)(
             logits_row[None], sub)[0])
+        now = self.clock()
         if not req.tokens:
-            req.t_first = self.clock()
+            req.t_first = now
+        req.t_last = now
+        req.tick_t.append(now)
         req.tokens.append(tok)
         self.tokens_out += 1
         if self.registry is not None:
@@ -217,6 +230,14 @@ class ServingEngine:
                 if req.t_first:
                     self.registry.observe("serve_ttft_s",
                                           req.t_first - req.t_submit)
+        record_terminal(req, reqtrace=self.reqtrace, slo=self.slo,
+                        now=req.t_done)
+
+    def _fail(self, req: Request, error: str) -> None:
+        """Resolve an unadmittable request as failed and record it."""
+        req._resolve("failed", error)
+        record_terminal(req, reqtrace=self.reqtrace, slo=self.slo,
+                        now=self.clock())
 
     # ---- admission ----
     def validate(self, req: Request) -> None:
@@ -256,8 +277,15 @@ class ServingEngine:
         except ValueError:
             return False
         with _span("serve_admit", slot=i, prompt_len=len(req.prompt),
-                   n_new=req.n_new), self._lock:
+                   n_new=req.n_new, rid=req.rid,
+                   corr=corr_id(req.rid)), self._lock:
             req.t_admit = self.clock()
+            if self.registry is not None and req.t_submit:
+                try:
+                    self.registry.observe("serve_queue_wait_s",
+                                          req.t_admit - req.t_submit)
+                except KeyError:
+                    pass   # registry predates the queue-wait histogram
             req.state = "active"
             req.model_step = self.model_step
             s0 = len(req.prompt)
@@ -290,7 +318,10 @@ class ServingEngine:
         if not live:
             return []
         emissions: List[Tuple[Request, int]] = []
-        with _span("serve_decode", active=len(live)), self._lock:
+        with _span("serve_decode", active=len(live)) as sargs, self._lock:
+            if sargs is not None:
+                # every rid in this tick, for request<->engine stitching
+                sargs["rids"] = [r.rid for _, r in live]
             self._cache, logits = self._vstep(
                 self._params, self._cache,
                 jnp.asarray(self._tok), jnp.asarray(self._pos))
@@ -338,7 +369,7 @@ class ServingEngine:
                 try:
                     self.admit(req)
                 except ValueError as e:
-                    req._resolve("failed", str(e))
+                    self._fail(req, str(e))
             if self.active_count:
                 self.step()
             ticks += 1
@@ -372,11 +403,16 @@ def serve_loop(engine: ServingEngine, queue, *, watcher=None,
                 if engine.admit(req):
                     admitted = True
             except ValueError as e:
-                req._resolve("failed", str(e))
+                engine._fail(req, str(e))
         if engine.active_count:
             engine.step()
         elif not admitted:
-            # idle: block briefly on the queue instead of spinning
+            # idle: resolve any expired waiters NOW (they would otherwise
+            # sit un-shed until the next take), then block briefly on the
+            # queue instead of spinning
+            reap = getattr(queue, "reap", None)
+            if reap is not None:
+                reap()
             queue.wait_nonempty(idle_wait_s)
         if (watcher is not None and reload_s > 0
                 and clock() - last_reload >= reload_s):

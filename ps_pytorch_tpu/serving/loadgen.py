@@ -13,17 +13,21 @@ Two drive modes, per the usual serving-bench taxonomy:
   backpressure/shedding. Wall-clock heavy, so its soak test is ``slow``.
 
 ``summarize`` turns resolved requests into the stats dict both modes (and
-bench_suite rows) report.
+bench_suite rows) report. ``run_slo_sweep`` stacks open-loop rungs into a
+rising-offered-load ladder judged against an ``--slo-spec`` and reports
+the knee + goodput-under-SLO (PERF.md §13's methodology).
 """
 
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
 from ps_pytorch_tpu.serving.engine import Request, ServingEngine, serve_loop
 from ps_pytorch_tpu.serving.queue import AdmissionQueue
+from ps_pytorch_tpu.serving.reqtrace import record_terminal
+from ps_pytorch_tpu.telemetry.slo import check_slo, parse_slo_spec
 
 
 def make_requests(n: int, *, prompt_len: int, n_new: int, vocab: int,
@@ -41,9 +45,20 @@ def make_requests(n: int, *, prompt_len: int, n_new: int, vocab: int,
     return reqs
 
 
-def summarize(requests: List[Request], wall_s: float) -> Dict:
+# Below this many completed requests, tail percentiles are suppressed —
+# np.percentile would happily interpolate a "p99" out of 3 samples, and an
+# SLO bound on that number would be noise dressed as a verdict.
+MIN_PERCENTILE_SAMPLES = 5
+
+
+def summarize(requests: List[Request], wall_s: float,
+              min_samples: int = MIN_PERCENTILE_SAMPLES) -> Dict:
     """Latency/throughput stats over RESOLVED requests. Only ``done``
-    requests contribute latency percentiles; shed/rejected are counted."""
+    requests contribute latency percentiles (``None`` below
+    ``min_samples`` of them); shed/rejected are counted.
+    ``availability`` is ``completed / (requests - rejected)`` — rejection
+    is backpressure the caller observed immediately, not a request the
+    engine accepted and then failed, so it doesn't burn availability."""
     done = [r for r in requests if r.state == "done"]
     out = {
         "requests": len(requests),
@@ -55,15 +70,26 @@ def summarize(requests: List[Request], wall_s: float) -> Dict:
         "tokens": int(sum(len(r.tokens) for r in done)),
     }
     out["tokens_per_sec"] = out["tokens"] / wall_s if wall_s > 0 else 0.0
-    if done:
+    eligible = out["requests"] - out["rejected"]
+    out["availability"] = (out["completed"] / eligible if eligible > 0
+                           else None)
+    pctls = {"ttft_p50_ms": None, "ttft_p99_ms": None,
+             "latency_p50_ms": None, "latency_p99_ms": None,
+             "queue_wait_p99_ms": None}
+    if len(done) >= max(1, min_samples):
         ttft = np.array([r.t_first - r.t_submit for r in done])
         lat = np.array([r.t_done - r.t_submit for r in done])
-        out.update(
+        pctls.update(
             ttft_p50_ms=float(np.percentile(ttft, 50) * 1e3),
             ttft_p99_ms=float(np.percentile(ttft, 99) * 1e3),
             latency_p50_ms=float(np.percentile(lat, 50) * 1e3),
             latency_p99_ms=float(np.percentile(lat, 99) * 1e3),
         )
+        admitted = [r for r in done if r.t_admit]
+        if len(admitted) >= max(1, min_samples):
+            qw = np.array([r.t_admit - r.t_submit for r in admitted])
+            pctls["queue_wait_p99_ms"] = float(np.percentile(qw, 99) * 1e3)
+    out.update(pctls)
     return out
 
 
@@ -85,7 +111,8 @@ def run_open_loop(engine: ServingEngine, requests: List[Request], *,
     AdmissionQueue drained by a ``serve_loop`` thread; returns ``summarize``
     stats over the whole set once every request resolves."""
     queue = AdmissionQueue(max_queue, clock=engine.clock,
-                           registry=engine.registry)
+                           registry=engine.registry,
+                           reqtrace=engine.reqtrace, slo=engine.slo)
     stop = threading.Event()
     loop = threading.Thread(
         target=serve_loop, args=(engine, queue),
@@ -105,7 +132,60 @@ def run_open_loop(engine: ServingEngine, requests: List[Request], *,
         for req in requests:
             if not req.wait(timeout_s):
                 req._resolve("failed", "loadgen timeout")
+                record_terminal(req, reqtrace=engine.reqtrace,
+                                slo=engine.slo, now=engine.clock())
     finally:
         stop.set()
         loop.join(timeout=10.0)
     return summarize(requests, engine.clock() - t0)
+
+
+def run_slo_sweep(engine: ServingEngine, slo_spec: str, *,
+                  rates: Sequence[float], n_req: int = 24,
+                  prompt_len: int = 32, n_new: int = 32,
+                  deadline_s: Optional[float] = None, max_queue: int = 64,
+                  seed: int = 0, timeout_s: float = 120.0) -> Dict:
+    """The SLO harness: a rising-offered-load Poisson ladder that finds the
+    KNEE — the max arrival rate still meeting every objective in
+    ``slo_spec`` — and reports goodput-under-SLO (tokens/sec at the knee
+    rung) as the headline.
+
+    Each rung runs ``run_open_loop`` at one offered rate over fresh
+    deterministic requests (rung r uses sampling seeds ``seed + 1000*r``,
+    so rungs never share a key chain) and is judged offline by
+    ``telemetry.slo.check_slo`` over its ``summarize`` stats — the rung IS
+    the window. The knee is the highest compliant rate; a rung that can't
+    prove compliance (percentiles suppressed for lack of samples, or any
+    objective missed) doesn't count. ``ok`` is False when NO rung complied
+    — the SLO is unachievable at every offered rate tried, which is a
+    finding, not a crash."""
+    objectives = parse_slo_spec(slo_spec)
+    if not objectives:
+        raise ValueError(f"slo_spec {slo_spec!r} has no objectives")
+    rates = sorted(float(r) for r in rates)
+    if not rates or rates[0] <= 0:
+        raise ValueError(f"rates must be positive (got {rates})")
+    ladder = []
+    for rung, rate in enumerate(rates):
+        reqs = make_requests(n_req, prompt_len=prompt_len, n_new=n_new,
+                             vocab=engine.vocab, seed=seed + 1000 * rung)
+        stats = run_open_loop(engine, reqs, rate_rps=rate,
+                              max_queue=max_queue, deadline_s=deadline_s,
+                              arrival_seed=seed + 1000 * rung,
+                              timeout_s=timeout_s)
+        verdict = check_slo(stats, objectives)
+        ladder.append({"rate_rps": rate, **stats, "slo": verdict})
+    knee = None
+    for rung in ladder:
+        if rung["slo"]["compliant"]:
+            knee = rung
+    return {
+        "slo_spec": slo_spec,
+        "objectives": [o.to_dict() for o in objectives],
+        "n_req_per_rung": int(n_req),
+        "ladder": ladder,
+        "knee_rps": None if knee is None else knee["rate_rps"],
+        "goodput_under_slo_tps": (None if knee is None
+                                  else knee["tokens_per_sec"]),
+        "ok": knee is not None,
+    }

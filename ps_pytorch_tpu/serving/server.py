@@ -19,6 +19,11 @@ API (JSON in, JSON out):
 - ``GET /stats``          engine/queue counters (+ registry snapshot).
 - ``GET /metrics``        Prometheus text exposition of the engine registry
   (404 when the engine was built without one).
+- ``GET /slo``            the SLO tracker's multi-window evaluation
+  (state, compliance, per-objective burn rates; 404 without ``--slo-spec``).
+- ``GET /debug/requests`` the request-trace ring — sampling stats + every
+  kept lifecycle record; ``?text=1`` renders an aligned table instead of
+  JSON (404 when request tracing is off).
 """
 
 import json
@@ -32,6 +37,8 @@ import numpy as np
 
 from ps_pytorch_tpu.serving.engine import Request, ServingEngine, serve_loop
 from ps_pytorch_tpu.serving.queue import AdmissionQueue
+from ps_pytorch_tpu.serving.reqtrace import (format_requests_table,
+                                             record_terminal)
 from ps_pytorch_tpu.telemetry.prometheus import CONTENT_TYPE, render
 
 
@@ -53,8 +60,12 @@ class ServingFrontend:
         # the training run that produced the served weights); checkpoint
         # reloads refresh the epoch from the new checkpoint's meta.
         self.identity = dict(identity or {})
+        # The queue resolves shed/rejected requests itself, so it needs the
+        # same trace/SLO sinks the engine feeds for completions.
         self.queue = AdmissionQueue(max_queue, clock=engine.clock,
-                                    registry=engine.registry)
+                                    registry=engine.registry,
+                                    reqtrace=engine.reqtrace,
+                                    slo=engine.slo)
         self.watcher = watcher
         self.reload_s = reload_s
         self.default_deadline_s = float(default_deadline_s)
@@ -145,6 +156,8 @@ class ServingFrontend:
         # (grace past the deadline so shedding reports as 504, not timeout).
         if not req.wait(deadline_s + 5.0):
             req._resolve("failed", "server wait timeout")
+            record_terminal(req, reqtrace=self.engine.reqtrace,
+                            slo=self.engine.slo, now=self.engine.clock())
             return 504, {"error": "timed out", "rid": req.rid}
         if req.state == "shed":
             return 504, {"error": req.error, "rid": req.rid}
@@ -226,6 +239,25 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_text(200, render(reg), CONTENT_TYPE)
         elif self.path == "/stats":
             self._send(200, self.fe.stats())
+        elif self.path == "/slo":
+            slo = self.fe.engine.slo
+            if slo is None:
+                self._send(404, {"error": "no SLO tracker (serve with "
+                                          "--slo-spec)"})
+            else:
+                self._send(200, slo.evaluate())
+        elif self.path.split("?")[0] == "/debug/requests":
+            log = self.fe.engine.reqtrace
+            if log is None:
+                self._send(404, {"error": "request tracing off (serve "
+                                          "with --reqtrace-keep > 0)"})
+            elif "text=1" in (self.path.split("?") + [""])[1]:
+                rows = log.snapshot()
+                self._send_text(200, format_requests_table(rows),
+                                "text/plain; charset=utf-8")
+            else:
+                self._send(200, {"stats": log.stats(),
+                                 "requests": log.snapshot()})
         else:
             self._send(404, {"error": f"no route {self.path}"})
 

@@ -2,8 +2,8 @@
 MFU/goodput derivation (registry.py), cross-host step aggregation over the
 control-plane KV (aggregate.py), and the live ops plane — Prometheus
 exposition/exporter (prometheus.py), training-health watchdogs (health.py),
-and the crash-dump flight recorder (flightrec.py). See each module's
-docstring."""
+sliding-window SLO burn-rate evaluation (slo.py), and the crash-dump
+flight recorder (flightrec.py). See each module's docstring."""
 
 from ps_pytorch_tpu.telemetry.aggregate import (  # noqa: F401
     TelemetryAggregator, read_timeline,
@@ -26,6 +26,9 @@ from ps_pytorch_tpu.telemetry.registry import (  # noqa: F401
     declare_resilience_metrics,
     declare_serving_metrics, declare_training_metrics, derive_step_record,
     device_memory_record, host_rss_bytes, step_flops_of,
+)
+from ps_pytorch_tpu.telemetry.slo import (  # noqa: F401
+    SLOObjective, SLOTracker, WindowPercentile, check_slo, parse_slo_spec,
 )
 from ps_pytorch_tpu.telemetry.trace import (  # noqa: F401
     Tracer, get_default_tracer, set_default_tracer, span,

@@ -28,6 +28,13 @@ as ``--fault-spec``):
         ``status()``/``check_stall()`` run from the exporter's /healthz
         thread, and :meth:`HealthMonitor.beat` marks liveness for loops
         with no step counter (the serving drive loop).
+    steptime[:action],p99_s=...[,window_s=60,min_n=20]
+        Sliding-window step-time p99 (telemetry/slo.WindowPercentile —
+        the serving SLO plane's estimator, reused trainer-side) exceeded
+        ``p99_s`` seconds. ``p99_s`` has no sane default and must be set;
+        the detector only trips on the RISING edge of an excursion and
+        re-arms once the p99 drops back under, so a slow patch is one
+        event, not one per step.
 
 Actions: ``warn`` (default — event + counters only), ``skip`` (nonfinite
 only: drop the poisoned update in-graph, keep training), ``halt``
@@ -44,7 +51,9 @@ from collections import deque
 from dataclasses import asdict, dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
-DETECTORS = ("nonfinite", "spike", "divergence", "stall")
+from ps_pytorch_tpu.telemetry.slo import WindowPercentile
+
+DETECTORS = ("nonfinite", "spike", "divergence", "stall", "steptime")
 ACTIONS = ("warn", "skip", "halt")
 
 # Per-detector tunables and their defaults; unknown keys fail at parse
@@ -55,6 +64,7 @@ _DEFAULTS: Dict[str, Dict[str, float]] = {
     "divergence": {"factor": 2.0, "margin": 0.0, "warmup": 20,
                    "decay": 0.98},
     "stall": {"factor": 10.0, "min_s": 5.0, "window": 64},
+    "steptime": {"p99_s": 0.0, "window_s": 60.0, "min_n": 20},
 }
 
 
@@ -107,6 +117,9 @@ def parse_health_spec(spec: str) -> List[Dict[str, Any]]:
             # flag exists inside the jitted step.
             raise ValueError(f"action 'skip' is only valid for 'nonfinite' "
                              f"(got {part!r})")
+        if det == "steptime" and entry["p99_s"] <= 0:
+            raise ValueError(f"steptime needs p99_s > 0 (got {part!r}); "
+                             "there is no sane default step-time bound")
         out.append(entry)
     return out
 
@@ -155,6 +168,10 @@ class HealthMonitor:
         self._loss_seen = 0
         self._step_times: deque = deque(
             maxlen=int(self._by_det.get("stall", {}).get("window", 64)))
+        st = self._by_det.get("steptime")
+        self._steptime_win = (None if st is None else WindowPercentile(
+            st["window_s"], clock=clock))
+        self._steptime_high = False
         self._last_progress = clock()
         self._stalled = False
         self.last_step = 0
@@ -214,6 +231,20 @@ class HealthMonitor:
         self.beat(now)
         if step_time is not None and step_time > 0:
             self._step_times.append(float(step_time))
+            if self._steptime_win is not None:
+                c = self._by_det["steptime"]
+                self._steptime_win.observe(float(step_time), now)
+                p99 = self._steptime_win.percentile(
+                    99.0, now, min_n=int(c["min_n"]))
+                if p99 is not None and p99 > c["p99_s"]:
+                    if not self._steptime_high:
+                        self._steptime_high = True
+                        events.append(self._trip(
+                            "steptime", step, p99, c["p99_s"],
+                            f"windowed step-time p99 {p99:.4g}s > "
+                            f"{c['p99_s']:g}s at step {step}"))
+                else:
+                    self._steptime_high = False
 
         bad = bool(nonfinite)
         for v in (loss, grad_norm):

@@ -248,15 +248,21 @@ SERVING_COUNTERS = (
     ("serve_rejected", "requests", "requests rejected at admission (queue full)"),
     ("serve_shed", "requests", "requests shed for a passed deadline"),
     ("serve_reloads", "events", "hot checkpoint reloads applied"),
+    ("slo_violations", "events", "per-request SLO objective violations"),
 )
 SERVING_GAUGES = (
     ("serve_active_slots", "slots", "decode slots currently occupied"),
     ("serve_queue_depth", "requests", "admission queue depth"),
     ("serve_model_step", "step", "checkpoint step currently served"),
+    ("slo_compliance", "", "fraction of SLO objectives met over the "
+                           "slow window (1.0 = all)"),
+    ("slo_burn_rate", "", "worst per-objective slow-window error-budget "
+                          "burn rate (1.0 = budget exactly)"),
 )
 SERVING_HISTOGRAMS = (
     ("serve_request_latency_s", "s", "submit -> last token latency"),
     ("serve_ttft_s", "s", "submit -> first token latency (TTFT)"),
+    ("serve_queue_wait_s", "s", "submit -> admission queue wait"),
 )
 
 

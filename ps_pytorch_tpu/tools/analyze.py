@@ -58,6 +58,16 @@ timeline — elections won/lost, joins/leaves/evictions, shard replans —
 merged chronologically across every process's dump:
 
     python -m ps_pytorch_tpu.tools.analyze membership 'run/flightrec.json*'
+
+Requests mode reads request-lifecycle traces (the /debug/requests JSON
+body or a JSONL dump of serving/reqtrace.py rows) and prints a per-phase
+waterfall — mean/p50/max of queue_wait/prefill/decode/stream_out and each
+phase's share of total latency — plus the slowest-request exemplars.
+Stitch also joins request spans to the engine's serve_admit/serve_decode
+spans (corr ``req/<rid>``; decode ticks fan out via ``args.rids``):
+
+    python -m ps_pytorch_tpu.tools.analyze requests /tmp/requests.json
+    python -m ps_pytorch_tpu.tools.analyze requests 'reqs*.jsonl' --json
 """
 
 import argparse
@@ -600,44 +610,75 @@ def membership_main(args, parser) -> int:
 
 def stitch_chrome_traces(docs: List[dict]) -> tuple:
     """Merge per-process Chrome traces into one doc and add flow events
-    joining each worker's ``wire_publish``/``wire_put`` span to the
-    leader's matching ``wire_read``/``get_decode`` span via the correlation
-    id (``args.corr``, stamped by transport.py on both legs).
+    joining spans by correlation id (``args.corr``):
+
+    - wire flows: each worker's ``wire_publish``/``wire_put`` span to the
+      leader's matching ``wire_read``/``get_decode`` span (transport.py
+      stamps both legs);
+    - request flows: each ``request`` lifecycle span (serving/reqtrace.py,
+      corr ``req/<rid>``) to the engine's ``serve_admit`` span with the
+      same corr AND to every ``serve_decode`` tick whose ``args.rids``
+      lists that request — the request↔engine join.
 
     Flow ids are ``zlib.crc32(corr)`` — deterministic, so re-stitching the
-    same traces yields identical ids. Returns ``(merged_doc, n_flows)``."""
+    same traces yields identical ids. Returns ``(merged_doc, n_flows)``
+    with n_flows counting both families."""
     import zlib
     merged: List[dict] = []
     pubs: Dict[str, dict] = {}
     reads: Dict[str, List[dict]] = {}
+    req_pubs: Dict[str, dict] = {}
+    req_reads: Dict[str, List[dict]] = {}
     for doc in docs:
         for e in doc.get("traceEvents", []):
             merged.append(e)
-            corr = (e.get("args") or {}).get("corr")
-            if e.get("ph") != "X" or not corr:
+            if e.get("ph") != "X":
                 continue
-            if e["name"] in ("wire_publish", "wire_put"):
+            eargs = e.get("args") or {}
+            corr = eargs.get("corr")
+            name = e.get("name")
+            if name == "serve_decode":
+                # one tick serves many requests: fan its rids out
+                for rid in eargs.get("rids", ()):
+                    req_reads.setdefault(f"req/{rid}", []).append(e)
+                continue
+            if not corr:
+                continue
+            if name in ("wire_publish", "wire_put"):
                 # Last publisher wins: one writer per corr by construction
                 # (the version/bucket id is in the corr string).
                 pubs[corr] = e
-            elif e["name"] in ("wire_read", "get_decode"):
+            elif name in ("wire_read", "get_decode"):
                 reads.setdefault(corr, []).append(e)
-    flows: List[dict] = []
-    for corr, pub in sorted(pubs.items()):
-        for rd in reads.get(corr, []):
-            fid = zlib.crc32(corr.encode("utf-8"))
-            flows.append({"ph": "s", "cat": "wire", "name": "wire_flow",
-                          "id": fid, "pid": pub["pid"], "tid": pub["tid"],
-                          "ts": pub["ts"] + pub.get("dur", 0),
-                          "args": {"corr": corr}})
-            flows.append({"ph": "f", "bp": "e", "cat": "wire",
-                          "name": "wire_flow", "id": fid, "pid": rd["pid"],
-                          "tid": rd["tid"], "ts": rd["ts"],
-                          "args": {"corr": corr}})
-    out = {"traceEvents": merged + flows, "displayTimeUnit": "ms",
+            elif name == "request":
+                req_pubs[corr] = e
+            elif name == "serve_admit":
+                req_reads.setdefault(corr, []).append(e)
+
+    def _flows(srcs, sinks, cat, fname, ts_of_src):
+        out = []
+        for corr, pub in sorted(srcs.items()):
+            for rd in sinks.get(corr, []):
+                fid = zlib.crc32(corr.encode("utf-8"))
+                out.append({"ph": "s", "cat": cat, "name": fname,
+                            "id": fid, "pid": pub["pid"], "tid": pub["tid"],
+                            "ts": ts_of_src(pub), "args": {"corr": corr}})
+                out.append({"ph": "f", "bp": "e", "cat": cat, "name": fname,
+                            "id": fid, "pid": rd["pid"], "tid": rd["tid"],
+                            "ts": rd["ts"], "args": {"corr": corr}})
+        return out
+
+    wire = _flows(pubs, reads, "wire", "wire_flow",
+                  lambda pub: pub["ts"] + pub.get("dur", 0))
+    # the request span COVERS its engine spans, so the arrow leaves its start
+    reqf = _flows(req_pubs, req_reads, "reqtrace", "req_flow",
+                  lambda pub: pub["ts"])
+    n_flows = (len(wire) + len(reqf)) // 2
+    out = {"traceEvents": merged + wire + reqf, "displayTimeUnit": "ms",
            "metadata": {"stitched_from": len(docs),
-                        "wire_flows": len(flows) // 2}}
-    return out, len(flows) // 2
+                        "wire_flows": len(wire) // 2,
+                        "request_flows": len(reqf) // 2}}
+    return out, n_flows
 
 
 def stitch_main(args, parser) -> int:
@@ -657,14 +698,122 @@ def stitch_main(args, parser) -> int:
     if args.out:
         with open(args.out, "w") as f:
             json.dump(merged, f)
+    meta = merged["metadata"]
     summary = {"files": len(files), "events": len(merged["traceEvents"]),
-               "wire_flows": n_flows, "out": args.out or None}
+               "flows": n_flows, "wire_flows": meta["wire_flows"],
+               "request_flows": meta["request_flows"],
+               "out": args.out or None}
     if args.json:
         print(json.dumps(summary))
     else:
         print(f"stitched {summary['files']} traces -> "
-              f"{summary['events']} events, {n_flows} wire flow pairs"
+              f"{summary['events']} events, {meta['wire_flows']} wire + "
+              f"{meta['request_flows']} request flow pairs"
               + (f" -> {args.out}" if args.out else ""))
+    return 0
+
+
+# ---- requests mode (per-request lifecycle waterfall) ----
+
+REQUEST_PHASES = ("queue_wait_s", "prefill_s", "decode_s", "stream_out_s")
+
+
+def read_request_rows(path: str) -> List[dict]:
+    """Load request-trace rows from a /debug/requests JSON body
+    (``{"requests": [...]}``), a bare JSON list, or JSON-lines of
+    ``RequestTrace.to_dict()`` rows."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        doc = json.loads(text)
+    except json.JSONDecodeError:
+        doc = [r for r in (json.loads(line) for line in text.splitlines()
+                           if line.strip()) if isinstance(r, dict)]
+    if isinstance(doc, dict):
+        doc = doc.get("requests", [])
+    return [r for r in doc if isinstance(r, dict) and "rid" in r]
+
+
+def _pctl(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    pos = (q / 100.0) * (len(sorted_vals) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(sorted_vals) - 1)
+    return sorted_vals[lo] + (pos - lo) * (sorted_vals[hi] - sorted_vals[lo])
+
+
+def requests_summary(rows: List[dict], top: int = 5) -> dict:
+    """Per-phase waterfall over request-trace rows: mean/p50/max seconds
+    per lifecycle phase plus each phase's share of total latency, and the
+    slowest-request exemplars (the rows tail sampling is for)."""
+    if not rows:
+        raise ValueError("no request rows")
+    phases = {}
+    total_lat = sum(float(r.get("latency_s") or 0.0) for r in rows)
+    for ph in REQUEST_PHASES:
+        vals = sorted(float(r.get(ph) or 0.0) for r in rows)
+        phases[ph] = {
+            "mean_ms": 1e3 * sum(vals) / len(vals),
+            "p50_ms": 1e3 * _pctl(vals, 50.0),
+            "max_ms": 1e3 * vals[-1],
+            "share": (sum(vals) / total_lat) if total_lat > 0 else 0.0,
+        }
+    outcomes: Dict[str, int] = {}
+    for r in rows:
+        out = str(r.get("outcome", "?"))
+        outcomes[out] = outcomes.get(out, 0) + 1
+    slowest = sorted(rows, key=lambda r: float(r.get("latency_s") or 0.0),
+                     reverse=True)[:top]
+    exemplars = [{
+        "rid": r.get("rid"), "outcome": r.get("outcome"),
+        "latency_ms": 1e3 * float(r.get("latency_s") or 0.0),
+        "n_tokens": r.get("n_tokens"), "kept": r.get("kept"),
+        **{ph[:-2] + "_ms": 1e3 * float(r.get(ph) or 0.0)
+           for ph in REQUEST_PHASES},
+    } for r in slowest]
+    return {"requests": len(rows), "outcomes": outcomes, "phases": phases,
+            "slowest": exemplars}
+
+
+def requests_markdown(summary: dict) -> str:
+    lines = [f"# request waterfall ({summary['requests']} traces; outcomes "
+             + " ".join(f"{k}={v}"
+                        for k, v in sorted(summary["outcomes"].items())) + ")",
+             "", "| phase | mean_ms | p50_ms | max_ms | share |",
+             "|---|---|---|---|---|"]
+    for ph in REQUEST_PHASES:
+        s = summary["phases"][ph]
+        lines.append(f"| {ph[:-2]} | {s['mean_ms']:.2f} | {s['p50_ms']:.2f} "
+                     f"| {s['max_ms']:.2f} | {100 * s['share']:.1f}% |")
+    lines.append("")
+    lines.append("## slowest requests")
+    lines.append("| rid | outcome | latency_ms | queue | prefill | decode "
+                 "| stream | tok |")
+    lines.append("|---|---|---|---|---|---|---|---|")
+    for r in summary["slowest"]:
+        lines.append(
+            f"| {r['rid']} | {r['outcome']} | {r['latency_ms']:.2f} "
+            f"| {r['queue_wait_ms']:.2f} | {r['prefill_ms']:.2f} "
+            f"| {r['decode_ms']:.2f} | {r['stream_out_ms']:.2f} "
+            f"| {r.get('n_tokens', '')} |")
+    return "\n".join(lines)
+
+
+def requests_main(args, parser) -> int:
+    files: List[str] = []
+    for pattern in args.runs:
+        files.extend(sorted(glob.glob(pattern)) or
+                     parser.error(f"no files match {pattern!r}") or [])
+    rows = [r for path in files for r in read_request_rows(path)]
+    try:
+        summary = requests_summary(rows)
+    except ValueError as e:
+        parser.error(f"{e} in {files}")
+    if args.json:
+        print(json.dumps(summary))
+    else:
+        print(requests_markdown(summary))
     return 0
 
 
@@ -701,6 +850,9 @@ def main(argv=None) -> int:
     if args.runs[0] == "membership":
         args.runs = args.runs[1:] or p.error("membership mode needs FILE...")
         return membership_main(args, p)
+    if args.runs[0] == "requests":
+        args.runs = args.runs[1:] or p.error("requests mode needs FILE...")
+        return requests_main(args, p)
 
     runs: Dict[str, List[str]] = {}
     for spec in args.runs:

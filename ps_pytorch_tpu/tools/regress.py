@@ -14,6 +14,10 @@ Families and their watched metrics (direction, relative tolerance):
 - ``ops``        BENCH_OPS_r*.json      overhead_frac must stay < 0.02
                                         absolute (the exporter+watchdog
                                         budget, not a relative drift)
+- ``slo``        SLO_r*.json            knee_rps >= the knee_bar recorded
+                                        in the artifact, reqtrace overhead
+                                        < 0.02 absolute, bitwise identity
+                                        and per-row ok must hold
 - ``resilience`` RESILIENCE_r*.json     boolean invariants must stay true
                                         (bitwise_equal/ok) and kv_giveups 0
 - ``elastic``    RESILIENCE_r*.json     newest artifact WITH an "elastic"
@@ -63,6 +67,16 @@ FAMILIES: Dict[str, dict] = {
     "ops": {
         "pattern": "BENCH_OPS_r[0-9]*.json",
         "metrics": [],              # absolute budget check, see _check_ops
+        "absolute": [("overhead_frac", 0.02)],
+    },
+    "slo": {
+        # Goodput-under-SLO artifact (bench_suite slo_sweep +
+        # serve_reqtrace_overhead rows). The knee bar travels IN the
+        # artifact (knee_bar = lowest offered rate of the ladder that
+        # produced it) so the gate needs no prior round: an engine that
+        # can't meet its own loose SLO at the gentlest rung regressed.
+        "pattern": "SLO_r[0-9]*.json",
+        "metrics": [],              # invariant check, see _check_slo
         "absolute": [("overhead_frac", 0.02)],
     },
     "resilience": {
@@ -141,6 +155,8 @@ def compare(family: str, baseline, candidate) -> dict:
         return _check_elastic(spec, candidate)
     if family == "ops":
         return _check_ops(spec, candidate)
+    if family == "slo":
+        return _check_slo(spec, candidate)
     base_rows, cand_rows = _by_config(baseline), _by_config(candidate)
     configs: Dict[str, dict] = {}
     ok = True
@@ -182,6 +198,45 @@ def _check_ops(spec: dict, candidate) -> dict:
         ok = False
         configs["_empty"] = {"ok": False, "note": "no ops rows"}
     return {"family": "ops", "ok": ok, "configs": configs}
+
+
+def _check_slo(spec: dict, candidate) -> dict:
+    configs: Dict[str, dict] = {}
+    ok = True
+    rows = _by_config(candidate)
+    sweep = rows.get("slo_sweep")
+    if sweep is None or "error" in sweep:
+        configs["slo_sweep"] = {"ok": False, "note": "no slo_sweep row"}
+        ok = False
+    else:
+        knee = sweep.get("knee_rps")
+        bar = float(sweep.get("knee_bar") or 0.0)
+        checks = {
+            "knee_rps": {"cand": knee, "floor": bar,
+                         "ok": knee is not None and float(knee) >= bar},
+            "ok": {"cand": sweep.get("ok"), "ok": sweep.get("ok") is True},
+        }
+        configs["slo_sweep"] = {"ok": all(c["ok"] for c in checks.values()),
+                                "metrics": checks}
+        ok = ok and configs["slo_sweep"]["ok"]
+    ovh = rows.get("serve_reqtrace_overhead")
+    if ovh is None or "error" in ovh:
+        configs["serve_reqtrace_overhead"] = {
+            "ok": False, "note": "no serve_reqtrace_overhead row"}
+        ok = False
+    else:
+        checks = {}
+        for metric, budget in spec["absolute"]:
+            val = float(ovh.get(metric, float("inf")))
+            checks[metric] = {"cand": val, "budget": budget,
+                              "ok": val < budget}
+        checks["bitwise_identical"] = {
+            "cand": ovh.get("bitwise_identical"),
+            "ok": ovh.get("bitwise_identical") is True}
+        configs["serve_reqtrace_overhead"] = {
+            "ok": all(c["ok"] for c in checks.values()), "metrics": checks}
+        ok = ok and configs["serve_reqtrace_overhead"]["ok"]
+    return {"family": "slo", "ok": ok, "configs": configs}
 
 
 def _check_resilience(spec: dict, candidate) -> dict:
@@ -243,7 +298,7 @@ def run_gate(family: str, candidate_path: str, repo: str = ".",
     against its predecessor."""
     candidate = load_artifact(candidate_path)
     baseline = None
-    if family not in ("resilience", "ops"):
+    if family not in ("resilience", "ops", "slo"):
         if baseline_path:
             baseline = load_artifact(baseline_path)
         else:
@@ -285,7 +340,7 @@ def run_all(repo: str = ".") -> dict:
                                             "section; skipped"}
                 continue
             families[family] = run_gate(family, with_section[-1], repo=repo)
-        elif family in ("resilience", "ops"):
+        elif family in ("resilience", "ops", "slo"):
             families[family] = run_gate(family, paths[-1], repo=repo)
         elif len(paths) < 2:
             families[family] = {"family": family, "ok": True,

@@ -318,6 +318,35 @@ def collect(repo: str):
             and all(r.get("ok") is True for r in rows
                     if "overhead_frac" in r),
             "errors": errors})
+    p = _newest("SLO_r[0-9]*.json", repo)
+    if p:
+        # Goodput-under-SLO evidence (bench_suite slo_sweep +
+        # serve_reqtrace_overhead rows): ok means the open-loop ladder
+        # found a knee at/above the artifact's own knee_bar AND the full
+        # request-observability plane stayed under its <2% budget with
+        # bitwise-identical tokens.
+        rows = _load(p)
+        if isinstance(rows, dict):
+            rows = [rows]
+        rows = [r for r in rows if isinstance(r, dict)]
+        errors = [r.get("config", r.get("_parse_error", "?")) for r in rows
+                  if "error" in r or "_parse_error" in r]
+        sweep = next((r for r in rows if r.get("config") == "slo_sweep"),
+                     None)
+        ovh = next((r for r in rows
+                    if r.get("config") == "serve_reqtrace_overhead"), None)
+        add("slo", p, {
+            "rows": len(rows),
+            "value": sweep.get("goodput_under_slo_tps") if sweep else None,
+            "unit": "tok/s under SLO (knee {} rps)".format(
+                sweep.get("knee_rps") if sweep else "?"),
+            "reqtrace_overhead_frac": (ovh.get("overhead_frac")
+                                       if ovh else None),
+            "platform": next((r.get("platform") for r in rows
+                              if r.get("platform")), "host"),
+            "ok": sweep is not None and ovh is not None and not errors
+            and sweep.get("ok") is True and ovh.get("ok") is True,
+            "errors": errors})
     p = _newest("REGRESS_r[0-9]*.json", repo)
     if p:
         # Regression-gate verdict (tools/regress.py 'all' mode): every

@@ -46,11 +46,13 @@ def main(argv=None) -> int:
     )
     from ps_pytorch_tpu.serving.engine import ServingEngine
     from ps_pytorch_tpu.serving.reload import CheckpointWatcher
+    from ps_pytorch_tpu.serving.reqtrace import RequestTraceLog
     from ps_pytorch_tpu.serving.server import ServingFrontend
     from ps_pytorch_tpu.telemetry.health import HealthMonitor
     from ps_pytorch_tpu.telemetry.registry import (
         Registry, declare_serving_metrics,
     )
+    from ps_pytorch_tpu.telemetry.slo import SLOTracker
 
     step = ckpt.latest_valid_step(args.train_dir)
     if step is None:
@@ -73,11 +75,19 @@ def main(argv=None) -> int:
     geo = lm_geometry(cfg)
     registry = Registry()
     declare_serving_metrics(registry)
+    # Request-scoped observability plane: lifecycle trace ring
+    # (/debug/requests) and SLO burn-rate tracker (/slo), both optional.
+    reqtrace = (RequestTraceLog(args.reqtrace_keep,
+                                sample=args.reqtrace_sample)
+                if args.reqtrace_keep > 0 else None)
+    slo = (SLOTracker(args.slo_spec, registry=registry)
+           if args.slo_spec else None)
     engine = ServingEngine(
         to_tree(state.params), slots=args.serve_slots,
         vocab=geo["vocab_size"], d_model=geo["d_model"],
         n_layers=geo["n_layers"], n_heads=geo["n_heads"],
-        max_seq_len=geo["max_seq_len"], model_step=step, registry=registry)
+        max_seq_len=geo["max_seq_len"], model_step=step, registry=registry,
+        reqtrace=reqtrace, slo=slo)
     watcher = None
     if args.serve_reload_s > 0:
         watcher = CheckpointWatcher(args.train_dir, template,
@@ -107,7 +117,9 @@ def main(argv=None) -> int:
                                  "/metrics",
                       "model_step": step, "slots": args.serve_slots,
                       "vocab": geo["vocab_size"],
-                      "seq_len": geo["max_seq_len"]}))
+                      "seq_len": geo["max_seq_len"],
+                      "slo_spec": args.slo_spec or None,
+                      "reqtrace_keep": args.reqtrace_keep}))
     sys.stdout.flush()
     try:
         while True:

@@ -444,6 +444,13 @@ def test_serving_healthz_and_metrics_http(tmp_path):
     assert samples["serve_requests_total"] >= 1
     assert samples["health_ok"] == 1
     assert any(k.startswith("serve_ttft_s_bucket") for k in samples)
+    # PR 8 contract gap-fill: the queue-wait histogram samples on every
+    # admission, and the SLO metrics are declared (counter stays 0 until
+    # an SLOTracker observes a violation).
+    assert any(k.startswith("serve_queue_wait_s_bucket") for k in samples)
+    assert samples["serve_queue_wait_s_count"] >= 1
+    assert samples["slo_violations_total"] == 0
+    assert "slo_compliance" in samples and "slo_burn_rate" in samples
 
 
 # ---- tools/regress.py: the bench regression gate ----
