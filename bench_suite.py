@@ -859,6 +859,144 @@ def bench_wire(name, steps, *, payload_mb=64, leaf_kb=1024, codec="blosc",
     return row
 
 
+def bench_codec_agg(name, steps, *, codec="int8lat", payload_mb=24,
+                    leaf_kb=1024, contributors=4, frac=0.01, rtt_ms=2.0,
+                    bucket_mb=4.0, workers=4, trace_out=""):
+    """Gradient-wire + leader-aggregation bench for one grad codec:
+    ``contributors`` senders each encode a payload_mb float32 gradient
+    tree, publish it through a KVPytreeChannel over the LatencyKV, and the
+    leader reads all of them back and aggregates. codec="blosc" is the
+    decode-then-average baseline (today's leader: per-contributor float32
+    trees, averaged in float). The homomorphic family (int8lat/topk/randk)
+    ships codec payloads instead and the leader sums them in the
+    compressed domain — submit_encoded + collect, ONE decode after the
+    cutoff. wire_mb is armoured bytes on the KV for all contributors;
+    bitwise_identical pins the homomorphic average against the
+    decode_then_average oracle over the exact same payloads."""
+    from ps_pytorch_tpu.compression.codecs import (
+        HOMOMORPHIC_GRAD_CODECS, decode_then_average, encode_leaves,
+        is_payload)
+    from ps_pytorch_tpu.parallel.async_dp import StaleGradientAggregator
+    from ps_pytorch_tpu.parallel.transport import KVPytreeChannel
+    from ps_pytorch_tpu.runtime.coordinator import KVStore
+
+    homomorphic = codec in HOMOMORPHIC_GRAD_CODECS
+    n_leaves = max(int(payload_mb * 1024 // leaf_kb), 1)
+    per_leaf = int(leaf_kb * 1024 // 4)
+    rng = np.random.default_rng(7)
+    trees = [{f"l{i:04d}": rng.normal(size=(per_leaf,))
+              .astype(np.float32) / 4.0 for i in range(n_leaves)}
+             for _ in range(contributors)]
+    leaves0, treedef = jax.tree.flatten(trees[0])
+    raw_bytes = contributors * sum(l.nbytes for l in leaves0)
+    bucket_bytes = int(bucket_mb * (1 << 20))
+    if homomorphic:
+        template = jax.tree.unflatten(treedef, encode_leaves(
+            codec, [np.zeros_like(l) for l in leaves0],
+            slice_id=0, step=0, frac=frac))
+    else:
+        template = trees[0]
+
+    encode_s = publish_s = read_s = agg_s = 0.0
+    wire_bytes = bitwise = rel_err = None
+    reps = max(min(steps, 3), 1)
+    for rep in range(reps):
+        kv = LatencyKV(KVStore(), rtt_ms / 1e3)
+        writers = [KVPytreeChannel(kv, f"bench/agg/{w}", template,
+                                   codec="blosc", bucket_bytes=bucket_bytes,
+                                   workers=workers)
+                   for w in range(contributors)]
+        readers = [KVPytreeChannel(kv, f"bench/agg/{w}", template,
+                                   codec="blosc", bucket_bytes=bucket_bytes,
+                                   workers=workers)
+                   for w in range(contributors)]
+        # Sender side: homomorphic codecs pay an explicit encode before
+        # the wire; the blosc baseline compresses inside publish().
+        t0 = time.perf_counter()
+        if homomorphic:
+            payloads = [encode_leaves(codec, jax.tree.leaves(t),
+                                      slice_id=w, step=rep, frac=frac)
+                        for w, t in enumerate(trees)]
+            wire_trees = [jax.tree.unflatten(treedef, p) for p in payloads]
+        else:
+            wire_trees = trees
+        t1 = time.perf_counter()
+        for w, tree in enumerate(wire_trees):
+            writers[w].publish(rep + 1, tree)
+        t2 = time.perf_counter()
+        got = [r.read() for r in readers]
+        t3 = time.perf_counter()
+        assert all(g is not None and g[0] == rep + 1 for g in got)
+        # Leader side: the real collect() path for this codec.
+        agg = StaleGradientAggregator(
+            contributors, staleness_limit=4, num_aggregate=0,
+            compress=homomorphic, codec=codec if homomorphic else "blosc",
+            topk_frac=frac)
+        t4 = time.perf_counter()
+        for w, (_, tree, _meta) in enumerate(got):
+            if homomorphic:
+                agg.submit_encoded(w, rep + 1, tree)
+            else:
+                agg.submit(w, rep + 1, tree)
+        avg, _info = agg.collect(rep + 1)
+        t5 = time.perf_counter()
+        encode_s += t1 - t0
+        publish_s += t2 - t1
+        read_s += t3 - t2
+        agg_s += t5 - t4
+        if rep == 0:
+            wire_bytes = sum(w.last_publish_bytes for w in writers)
+            avg_leaves = [np.asarray(l) for l in jax.tree.leaves(avg)]
+            true_mean = [np.mean([t[k] for t in trees], axis=0)
+                         for k in sorted(trees[0])]
+            num = sum(float(np.sum((a - m) ** 2))
+                      for a, m in zip(avg_leaves, true_mean))
+            den = sum(float(np.sum(m ** 2)) for m in true_mean)
+            rel_err = round((num / max(den, 1e-30)) ** 0.5, 6)
+            if homomorphic:
+                # Oracle: decode every contribution, average in float — the
+                # compressed-domain sum must match it bitwise (int8lat) /
+                # exactly per-position (sparse adds in the same order).
+                oracle = decode_then_average(
+                    codec, [(1.0, [l for l in jax.tree.leaves(
+                        got[w][1], is_leaf=is_payload)])
+                        for w in range(contributors)])
+                oracle = [o.reshape(a.shape)
+                          for o, a in zip(oracle, avg_leaves)]
+                bitwise = all(np.array_equal(a, o)
+                              for a, o in zip(avg_leaves, oracle))
+    row = {"config": name, "platform": "host", "grad_codec": codec,
+           "contributors": contributors, "payload_mb": payload_mb,
+           "leaves": n_leaves, "frac": frac if homomorphic else None,
+           "rtt_ms": rtt_ms, "bucket_mb": bucket_mb, "workers": workers,
+           "raw_mb": round(raw_bytes / 1e6, 2),
+           "wire_mb": round(wire_bytes / 1e6, 2),
+           "wire_ratio": round(raw_bytes / max(wire_bytes, 1), 2),
+           "encode_s": round(encode_s / reps, 3),
+           "publish_s": round(publish_s / reps, 3),
+           "read_s": round(read_s / reps, 3),
+           "agg_s": round(agg_s / reps, 4),
+           "total_s": round((encode_s + publish_s + read_s + agg_s)
+                            / reps, 3),
+           "agg_rel_err": rel_err, "bitwise_identical": bitwise,
+           "steps": reps}
+    if trace_out:
+        from ps_pytorch_tpu.telemetry import Tracer, set_default_tracer
+        tracer = Tracer(pid=0)
+        prev = set_default_tracer(tracer)
+        try:
+            kv = LatencyKV(KVStore(), rtt_ms / 1e3)
+            ch = KVPytreeChannel(kv, "bench/agg/0", template, codec="blosc",
+                                 bucket_bytes=bucket_bytes, workers=workers)
+            ch.publish(1, wire_trees[0])
+        finally:
+            set_default_tracer(prev)
+        with open(trace_out, "w") as f:
+            for s in tracer.spans():
+                f.write(json.dumps(s) + "\n")
+    return row
+
+
 def bench_ops_overhead(name, steps, *, batch=256, reps=3):
     """Ops-plane cost row: the SAME jitted LeNet step loop timed bare and
     with the full live-ops work per step — running /metrics exporter,
@@ -1105,6 +1243,21 @@ CONFIGS = {
     "wire_overlapped_64mb": lambda steps: bench_wire(
         "wire_overlapped_64mb", min(steps, 3), payload_mb=64,
         bucket_mb=4, workers=4),
+    # -- homomorphic gradient codecs (compression/codecs.py + async_dp
+    # submit_encoded/collect): 4 contributors x 24 MB through the same
+    # LatencyKV wire, leader aggregating in the compressed domain. The
+    # blosc row is the decode-then-average baseline; main() derives
+    # wire_codec_win_* from each pair (ISSUE 9 acceptance: topk@0.01
+    # >= 2x wire-bytes cut, int8lat end-to-end win + bitwise-identical
+    # to the decode-then-average oracle). --
+    "wire_codec_blosc_24mb": lambda steps: bench_codec_agg(
+        "wire_codec_blosc_24mb", min(steps, 3), codec="blosc"),
+    "wire_codec_int8lat_24mb": lambda steps: bench_codec_agg(
+        "wire_codec_int8lat_24mb", min(steps, 3), codec="int8lat"),
+    "wire_codec_topk_24mb": lambda steps: bench_codec_agg(
+        "wire_codec_topk_24mb", min(steps, 3), codec="topk", frac=0.01),
+    "wire_codec_randk_24mb": lambda steps: bench_codec_agg(
+        "wire_codec_randk_24mb", min(steps, 3), codec="randk", frac=0.01),
     # -- serving (ps_pytorch_tpu/serving/): 8 concurrent requests, batched
     # (8 slots) vs sequential (1 slot) through the same engine. main()
     # derives serve_batch_win_8 (ISSUE 5 acceptance: >= 1.5x tokens/sec AND
@@ -1243,6 +1396,41 @@ def main(argv=None) -> int:
                "ok": bool(bitwise and ratio >= 1.25)}
         print(json.dumps(out), flush=True)
         rows.append(out)
+
+    # Homomorphic grad codecs: each codec row vs the blosc decode-then-
+    # average baseline at the same geometry. wire_ratio is bytes-on-wire
+    # cut, total_ratio the end-to-end (encode+publish+read+aggregate) win.
+    # ISSUE 9 bars: topk@0.01 needs >= 2x wire cut; int8lat needs an
+    # end-to-end win AND bitwise identity to the oracle (a fast lossy
+    # "lossless" path is a broken path).
+    base = next((r for r in rows if r.get("config") == "wire_codec_blosc_24mb"
+                 and "error" not in r), None)
+    if base:
+        for cname in ("int8lat", "topk", "randk"):
+            row = next((r for r in rows
+                        if r.get("config") == f"wire_codec_{cname}_24mb"
+                        and "error" not in r), None)
+            if row is None:
+                continue
+            wire_ratio = base["wire_mb"] / max(row["wire_mb"], 1e-9)
+            total_ratio = base["total_s"] / max(row["total_s"], 1e-9)
+            out = {"config": f"wire_codec_win_{cname}_24mb",
+                   "baseline_wire_mb": base["wire_mb"],
+                   "wire_mb": row["wire_mb"],
+                   "wire_ratio": round(wire_ratio, 3),
+                   "baseline_total_s": base["total_s"],
+                   "total_s": row["total_s"],
+                   "total_ratio": round(total_ratio, 3),
+                   "bitwise_identical": row.get("bitwise_identical"),
+                   "agg_rel_err": row.get("agg_rel_err")}
+            if cname == "int8lat":
+                out["ok"] = bool(out["bitwise_identical"]
+                                 and total_ratio > 1.0 and wire_ratio >= 2.0)
+            else:
+                out["ok"] = bool(out["bitwise_identical"]
+                                 and wire_ratio >= 2.0)
+            print(json.dumps(out), flush=True)
+            rows.append(out)
 
     # Serving: batched (8 slots) vs sequential (1 slot) aggregate
     # tokens/sec at 8 concurrent requests, AND the two runs' sampled tokens

@@ -80,7 +80,9 @@ class TrainConfig:
     # -- compression (reference: --compress-grad, compression.py) --
     compress_grad: bool = False      # compress DCN-crossing gradient mirrors / checkpoints
     codec_level: int = 3
-    grad_codec: str = "blosc"        # blosc (lossless, native C++) | int8 (on-device Pallas)
+    grad_codec: str = "blosc"        # blosc | int8 (on-device Pallas) | int8lat/topk/randk (homomorphic: leader sums in the compressed domain, compression/codecs.py)
+    grad_topk_frac: float = 0.01     # topk/randk: fraction of entries kept per leaf
+    ef: bool = False                 # sender-side error feedback for lossy homomorphic codecs (residual carried across steps, checkpointed)
 
     # -- overlapped gradient wire (parallel/buckets.py + transport.py; the
     #    reference's per-layer send-during-backward, resnet_split.py:25-42) --
@@ -178,8 +180,20 @@ class TrainConfig:
             # 0 reaches the pp step as a division by zero mid-trace.
             raise ValueError(f"lm_microbatches={self.lm_microbatches} "
                              "(must be >= 1)")
-        if self.grad_codec not in ("blosc", "int8"):
-            raise ValueError(f"unknown grad_codec {self.grad_codec!r} (blosc | int8)")
+        # One registry, one message: the channel, the aggregator, and this
+        # config all reject unknown codecs through require_codec, so a typo
+        # reads identically wherever it is caught.
+        from ps_pytorch_tpu.compression.codecs import (
+            EF_GRAD_CODECS, GRAD_CODECS, require_codec,
+        )
+        require_codec("grad_codec", self.grad_codec, GRAD_CODECS)
+        if not (0.0 < self.grad_topk_frac <= 1.0):
+            raise ValueError(f"grad_topk_frac={self.grad_topk_frac} "
+                             "(must be in (0, 1])")
+        if self.ef and self.grad_codec not in EF_GRAD_CODECS:
+            raise ValueError(
+                f"--ef requires a lossy homomorphic grad_codec "
+                f"({' | '.join(EF_GRAD_CODECS)}), got {self.grad_codec!r}")
         if self.conv_impl not in ("xla", "pallas", "pallas_im2col"):
             raise ValueError(f"unknown conv_impl {self.conv_impl!r} "
                              "(xla | pallas | pallas_im2col)")

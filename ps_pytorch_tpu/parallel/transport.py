@@ -41,7 +41,6 @@ and the once-only fault semantics from resilience/ are unchanged.
 ``bucket_bytes == 0`` takes the legacy single-payload code path untouched.
 """
 
-import io
 import json
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, List, Optional, Tuple
@@ -49,44 +48,44 @@ from typing import Any, List, Optional, Tuple
 import jax
 import numpy as np
 
-from ps_pytorch_tpu.compression import g_compress, g_decompress
+from ps_pytorch_tpu.compression.codecs import (
+    CHANNEL_CODECS, decode_channel_leaf, encode_channel_leaf, require_codec,
+)
 from ps_pytorch_tpu.parallel.buckets import (
-    bucket_counts, plan_buckets, stream_buckets,
+    bucket_counts, leaf_nbytes, plan_buckets, stream_buckets,
 )
 from ps_pytorch_tpu.resilience.retry import is_retryable
 from ps_pytorch_tpu.telemetry.trace import span as _span
 from ps_pytorch_tpu.utils.armor import b85decode, b85encode
 
 _CHUNK = 1 << 18  # 256 KiB of base85 text per KV value (what bytes_out counts)
-_RAW_MAGIC = b"NPYRAW0:"
 
 
 def _encode_leaf(leaf, level: int, codec: str) -> List[str]:
-    if codec == "raw":
-        # --compress-grad off: self-describing uncompressed framing.
-        buf = io.BytesIO()
-        np.save(buf, np.asarray(leaf), allow_pickle=False)
-        raw = _RAW_MAGIC + buf.getvalue()
-    else:
-        raw = g_compress(np.asarray(leaf), level=level)
+    """Armoured chunks for one leaf. The framing itself comes from the
+    channel-codec registry (compression/codecs.py) — any registered codec
+    works here, and an unknown name raises the registry's shared message
+    instead of being silently treated as blosc."""
+    raw = encode_channel_leaf(leaf, level, codec)
     b85 = b85encode(raw).decode("ascii")
     return [b85[i:i + _CHUNK] for i in range(0, len(b85), _CHUNK)] or [""]
 
 
 def _decode_leaf(chunks: List[str]) -> np.ndarray:
-    raw = b85decode("".join(chunks))
-    if raw.startswith(_RAW_MAGIC):
-        return np.load(io.BytesIO(raw[len(_RAW_MAGIC):]), allow_pickle=False)
-    return g_decompress(raw)
+    # Self-describing framing: the registry decoder recognizes the codec
+    # from the bytes, so no codec name travels with the payload.
+    return decode_channel_leaf(b85decode("".join(chunks)))
 
 
 class KVPytreeChannel:
     """One single-writer slot publishing versioned pytrees over a KVStore.
 
-    ``codec``: 'blosc' (native C++ lossless, the reference's
-    ``--compress-grad`` wire format) or 'raw' (uncompressed npy framing,
-    the --compress-grad-off contract). Decoding is self-describing either
-    way, so mixed readers/writers cannot misinterpret bytes.
+    ``codec``: any name in the channel-codec registry
+    (compression/codecs.py CHANNEL_CODECS) — 'blosc' (native C++ lossless,
+    the reference's ``--compress-grad`` wire format) or 'raw' (uncompressed
+    npy framing, the --compress-grad-off contract). Decoding is
+    self-describing either way, so mixed readers/writers cannot
+    misinterpret bytes.
 
     ``bucket_bytes``/``workers``: the overlapped schedule (module
     docstring). 0 workers or 0 bucket_bytes degrades gracefully — same
@@ -96,8 +95,7 @@ class KVPytreeChannel:
     def __init__(self, kv, prefix: str, template: Any, level: int = 3,
                  codec: str = "blosc", bucket_bytes: int = 0,
                  workers: int = 0):
-        if codec not in ("blosc", "raw"):
-            raise ValueError(f"unknown channel codec {codec!r} (blosc | raw)")
+        require_codec("channel codec", codec, CHANNEL_CODECS)
         self.kv = kv
         self.prefix = prefix
         self.level = level
@@ -108,7 +106,9 @@ class KVPytreeChannel:
         self.n_leaves = len(leaves)
         self.bytes_out = 0          # armoured bytes written (cumulative)
         self.bytes_in = 0           # armoured bytes read (cumulative)
+        self.bytes_raw_out = 0      # pre-codec payload bytes (cumulative)
         self.last_publish_bytes = 0
+        self.last_publish_raw_bytes = 0
         self.last_publish_bucket_bytes: List[int] = []  # armoured, per bucket
         self.publishes = 0
         self.read_errors = 0        # transient read failures tolerated
@@ -129,7 +129,7 @@ class KVPytreeChannel:
         # Chrome trace into flow arrows on that shared id.
         corr = f"{self.prefix}@{version}"
         with _span("wire_publish", channel=self.prefix, version=version,
-                   corr=corr):
+                   corr=corr) as sargs:
             leaves, treedef = jax.tree.flatten(tree)
             if treedef != self.treedef:
                 raise ValueError("published tree structure != channel template")
@@ -137,6 +137,11 @@ class KVPytreeChannel:
                 chunk_counts, extra = self._put_bucketed(version, leaves)
             else:
                 chunk_counts, extra = self._put_serial(version, leaves)
+            if sargs is not None:
+                # Compressed-vs-raw accounting rides the span so analyze.py
+                # can report per-publish codec ratios straight off the JSONL.
+                sargs["bytes"] = self.last_publish_bytes
+                sargs["bytes_raw"] = self.last_publish_raw_bytes
             self.publishes += 1
             self.kv.set(f"{self.prefix}/{version}/meta",
                         json.dumps({**(meta or {}), "chunks": chunk_counts,
@@ -151,15 +156,18 @@ class KVPytreeChannel:
         """Legacy blocking wire: leaf-at-a-time encode+put, byte-exact with
         every payload this channel ever produced before bucketing existed."""
         chunk_counts = []
-        nbytes = 0
+        nbytes = raw_bytes = 0
         for l_idx, leaf in enumerate(leaves):
             chunks = _encode_leaf(leaf, self.level, self.codec)
             chunk_counts.append(len(chunks))
             nbytes += sum(len(c) for c in chunks)
+            raw_bytes += leaf_nbytes(leaf)
             for c_idx, c in enumerate(chunks):
                 self.kv.set(f"{self.prefix}/{version}/{l_idx}/{c_idx}", c)
         self.bytes_out += nbytes
+        self.bytes_raw_out += raw_bytes
         self.last_publish_bytes = nbytes
+        self.last_publish_raw_bytes = raw_bytes
         self.last_publish_bucket_bytes = [nbytes]
         return chunk_counts, {}
 
@@ -172,24 +180,29 @@ class KVPytreeChannel:
         def encode_put(b, block):
             bcorr = f"{self.prefix}@{version}/b{b.index}"
             with _span("wire_encode", channel=self.prefix, bucket=b.index,
-                       leaves=len(block)):
+                       leaves=len(block), bytes_raw=b.nbytes) as eargs:
                 texts = [_encode_leaf(l, self.level, self.codec)
                          for l in block]
-            nbytes = sum(len(c) for chunks in texts for c in chunks)
+                nbytes = sum(len(c) for chunks in texts for c in chunks)
+                if eargs is not None:
+                    eargs["bytes"] = nbytes
             with _span("wire_put", channel=self.prefix, bucket=b.index,
-                       bytes=nbytes, corr=bcorr):
+                       bytes=nbytes, bytes_raw=b.nbytes, corr=bcorr):
                 for off, chunks in enumerate(texts):
                     l_idx = b.start + off
                     for c_idx, c in enumerate(chunks):
                         self.kv.set(f"{self.prefix}/{version}/{l_idx}/{c_idx}",
                                     c)
-            return [len(chunks) for chunks in texts], nbytes
+            return [len(chunks) for chunks in texts], nbytes, b.nbytes
 
         results = stream_buckets(leaves, bks, encode_put, pool)
-        chunk_counts = [n for counts, _ in results for n in counts]
-        per_bucket = [nb for _, nb in results]
+        chunk_counts = [n for counts, _, _ in results for n in counts]
+        per_bucket = [nb for _, nb, _ in results]
+        raw_bytes = sum(rb for _, _, rb in results)
         self.bytes_out += sum(per_bucket)
+        self.bytes_raw_out += raw_bytes
         self.last_publish_bytes = sum(per_bucket)
+        self.last_publish_raw_bytes = raw_bytes
         self.last_publish_bucket_bytes = per_bucket
         return chunk_counts, {"buckets": bucket_counts(bks)}
 
@@ -370,6 +383,7 @@ class KVGradientTransport:
         return {
             "wire_bytes_out": sum(c.bytes_out for c in chans),
             "wire_bytes_in": sum(c.bytes_in for c in chans),
+            "wire_raw_bytes_out": sum(c.bytes_raw_out for c in chans),
             "param_publishes": self.param_ch.publishes,
             "last_param_publish_bytes": self.param_ch.last_publish_bytes,
             "wire_read_errors": sum(c.read_errors for c in chans),

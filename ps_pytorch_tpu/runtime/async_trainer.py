@@ -134,12 +134,34 @@ class AsyncTrainer:
         # (--compress-grad / --grad-codec): off -> raw npy framing;
         # blosc -> C++ lossless; int8 -> on-device Pallas quantization, the
         # components then blosc-framed (4x smaller before the bytes leave
-        # the chip).
+        # the chip); int8lat/topk/randk -> homomorphic payloads the leader
+        # sums IN THE COMPRESSED DOMAIN (compression/codecs.py) without
+        # ever materializing a per-contributor float32 tree.
+        from ps_pytorch_tpu.compression.codecs import (
+            HOMOMORPHIC_GRAD_CODECS, encode_leaves,
+        )
         self._wire_int8 = cfg.compress_grad and cfg.grad_codec == "int8"
+        self._wire_homo = cfg.compress_grad and \
+            cfg.grad_codec in HOMOMORPHIC_GRAD_CODECS
+        self._ef = None           # sender-side EF residuals (lazy, --ef)
+        self._enc_pool = None     # encode-side bucket pool (lazy)
         chan_codec = "blosc" if cfg.compress_grad else "raw"
-        grad_template = self.params if not self._wire_int8 else \
-            jax.tree.map(lambda a: {"v": np.zeros(0, np.int8),
-                                    "s": np.zeros(0, np.float32)}, self.params)
+        if self._wire_homo:
+            # Template = a zero-gradient encode: payload shapes are
+            # data-independent (k from --grad-topk-frac, "v" from the leaf
+            # shape), so one throwaway encode fixes the wire structure.
+            leaves, treedef = jax.tree.flatten(self.params)
+            grad_template = jax.tree.unflatten(
+                treedef, encode_leaves(
+                    cfg.grad_codec,
+                    [np.zeros(np.shape(l), np.float32) for l in leaves],
+                    slice_id=0, step=0, frac=cfg.grad_topk_frac))
+        elif self._wire_int8:
+            grad_template = jax.tree.map(
+                lambda a: {"v": np.zeros(0, np.int8),
+                           "s": np.zeros(0, np.float32)}, self.params)
+        else:
+            grad_template = self.params
         # Shape/size reference for wire decode (structure only, no storage).
         self._param_tpl = jax.tree.map(
             lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), self.params)
@@ -205,11 +227,7 @@ class AsyncTrainer:
         self._seq = 0
         if self.leader:
             self.opt_state = self.tx.init(variables["params"])
-            self.aggregator = StaleGradientAggregator(
-                self.n, staleness_limit=cfg.staleness_limit,
-                staleness_decay=cfg.staleness_decay,
-                num_aggregate=cfg.num_aggregate,
-                compress=False)  # the WIRE is compressed; the pool is local
+            self.aggregator = self._make_leader_aggregator()
             # out_shardings pins the updated params/opt state REPLICATED
             # over the local mesh: a bare jit would commit them to one
             # device, and the next multi-device shard_map grad_fn call
@@ -219,6 +237,23 @@ class AsyncTrainer:
             self._update = jax.jit(
                 lambda p, o, g: apply_optimizer(self.tx, p, o, g),
                 out_shardings=(rep, rep))
+
+    def _make_leader_aggregator(self) -> StaleGradientAggregator:
+        cfg = self.cfg
+        if self._wire_homo:
+            # Homomorphic wire: the pool holds PAYLOADS (submit_encoded)
+            # and collect() sums them in the compressed domain. EF stays
+            # sender-side — each process compensates its own encodes.
+            return StaleGradientAggregator(
+                self.n, staleness_limit=cfg.staleness_limit,
+                staleness_decay=cfg.staleness_decay,
+                num_aggregate=cfg.num_aggregate, compress=True,
+                codec=cfg.grad_codec, topk_frac=cfg.grad_topk_frac)
+        return StaleGradientAggregator(
+            self.n, staleness_limit=cfg.staleness_limit,
+            staleness_decay=cfg.staleness_decay,
+            num_aggregate=cfg.num_aggregate,
+            compress=False)  # the WIRE is compressed; the pool is local
 
     def _health_status(self) -> dict:
         body = {"ok": True, "process_index": self.pid,
@@ -243,12 +278,17 @@ class AsyncTrainer:
             # serving /healthz surfaces it for the checkpoints it reloads.
             extra = {"leader_epoch": self.election.epoch,
                      "leader_pid": self.pid}
+        # The leader's own EF residual rides the checkpoint as extra state
+        # (followers hold their own; a restarted follower restarts with a
+        # zero residual, like a freshly relaunched reference worker).
+        extra_state = {"ef": self._ef.state_dict()} \
+            if (self.cfg.ef and self._ef is not None) else None
         ckpt.save_checkpoint(self.cfg.train_dir, self.version,
                              jax.device_get(self._as_train_state()),
                              config_json=self.cfg.to_json(),
                              compress=self.cfg.compress_grad,
                              codec_level=self.cfg.codec_level,
-                             extra_meta=extra)
+                             extra_meta=extra, extra_state=extra_state)
         if self.injector is not None:
             self.injector.after_checkpoint(self.cfg.train_dir, self.version)
         if self.cfg.ckpt_keep > 0:
@@ -267,12 +307,36 @@ class AsyncTrainer:
         self.opt_state = jax.device_put(state.opt_state, self._rep)
         self._bs = jax.device_put(state.batch_stats)
         self.version = int(meta["step"])
+        extra = ckpt.load_extra_state(self.cfg.train_dir, step)
+        if extra and "ef" in extra:
+            from ps_pytorch_tpu.compression.codecs import ErrorFeedback
+            self._ef = ErrorFeedback()
+            self._ef.load_state_dict(extra["ef"])
         print(f"RESUME from {ckpt.checkpoint_path(self.cfg.train_dir, step)} "
               f"at step {self.version}")
         return True
 
     # ---- wire codecs ----
     def _encode_grads(self, grads):
+        if self._wire_homo:
+            from ps_pytorch_tpu.compression.codecs import (
+                ErrorFeedback, encode_leaves,
+            )
+            if self.cfg.ef and self._ef is None:
+                self._ef = ErrorFeedback()
+            leaves, treedef = jax.tree.flatten(grads)
+            # Per-bucket streaming: encode + EF-update of bucket k runs on
+            # the pool while bucket k+1 is still syncing off-device — the
+            # homomorphic wire's analogue of the overlapped blosc/int8
+            # schedule. Payloads are bitwise-invariant to the bucketing
+            # (global flat leaf index), so overlap never changes the wire.
+            payloads = encode_leaves(
+                self.cfg.grad_codec, leaves, slice_id=self.pid,
+                step=self._seq, frac=self.cfg.grad_topk_frac, ef=self._ef,
+                bucket_bytes=(int(self.cfg.wire_bucket_mb * (1 << 20))
+                              if self._wire_overlap else 0),
+                pool=self._encode_pool())
+            return jax.tree.unflatten(treedef, payloads)
         if not self._wire_int8:
             # Overlapped wire: hand the DEVICE arrays to the channel — it
             # blocks per BUCKET (flat-leaf order) and encodes bucket k while
@@ -295,6 +359,15 @@ class AsyncTrainer:
                 enc.append({"v": np.asarray(qt.values),
                             "s": np.asarray(qt.scales)})
         return jax.tree.unflatten(treedef, enc)
+
+    def _encode_pool(self):
+        if self._enc_pool is None and self._wire_overlap \
+                and self.cfg.wire_workers > 1:
+            from concurrent.futures import ThreadPoolExecutor
+            self._enc_pool = ThreadPoolExecutor(
+                max_workers=self.cfg.wire_workers,
+                thread_name_prefix="grad-enc")
+        return self._enc_pool
 
     def _decode_grads(self, wire):
         if not self._wire_int8:
@@ -326,10 +399,7 @@ class AsyncTrainer:
         the takeover with a fresh publish so followers re-anchor."""
         cfg = self.cfg
         rep = self._rep
-        self.aggregator = StaleGradientAggregator(
-            self.n, staleness_limit=cfg.staleness_limit,
-            staleness_decay=cfg.staleness_decay,
-            num_aggregate=cfg.num_aggregate, compress=False)
+        self.aggregator = self._make_leader_aggregator()
         self._update = jax.jit(
             lambda p, o, g: apply_optimizer(self.tx, p, o, g),
             out_shardings=(rep, rep))
@@ -417,7 +487,13 @@ class AsyncTrainer:
         """Pool new wire contributions and apply at most one update.
         Returns number of contributions used."""
         for s, step, wire in self.transport.poll_new_grads():
-            self.aggregator.submit(s, step, self._decode_grads(wire))
+            if self._wire_homo:
+                # Payloads enter the pool AS PAYLOADS: no per-contributor
+                # float32 is ever materialized leader-side; decode happens
+                # once, after the K-of-N cutoff inside collect().
+                self.aggregator.submit_encoded(s, step, wire)
+            else:
+                self.aggregator.submit(s, step, self._decode_grads(wire))
         avg, pool = self.aggregator.collect(self.version)
         used = 0
         if avg is not None and pool["used"]:

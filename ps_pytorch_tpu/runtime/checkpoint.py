@@ -64,16 +64,25 @@ def _sha256_file(path: str) -> str:
 
 def save_checkpoint(train_dir: str, step: int, state: Any,
                     config_json: str = "{}", compress: bool = False,
-                    codec_level: int = 3, extra_meta: Optional[dict] = None) -> str:
-    """Atomically write train_dir/model_step_<step>. Returns the final path."""
+                    codec_level: int = 3, extra_meta: Optional[dict] = None,
+                    extra_state: Optional[Any] = None) -> str:
+    """Atomically write train_dir/model_step_<step>. Returns the final path.
+
+    ``extra_state``: optional auxiliary pytree (e.g. error-feedback
+    residuals) committed alongside the model as ``extra_state.msgpack`` —
+    same atomic rename, same manifest coverage, restored via
+    :func:`load_extra_state`.
+    """
     with _span("checkpoint_write", step=step):
         return _save_checkpoint(train_dir, step, state, config_json,
-                                compress, codec_level, extra_meta)
+                                compress, codec_level, extra_meta,
+                                extra_state)
 
 
 def _save_checkpoint(train_dir: str, step: int, state: Any,
                      config_json: str, compress: bool,
-                     codec_level: int, extra_meta: Optional[dict]) -> str:
+                     codec_level: int, extra_meta: Optional[dict],
+                     extra_state: Optional[Any] = None) -> str:
     os.makedirs(train_dir, exist_ok=True)
     state = jax.device_get(state)
     blob = serialization.to_bytes(state)
@@ -96,6 +105,9 @@ def _save_checkpoint(train_dir: str, step: int, state: Any,
         f.write(blob)
     with open(os.path.join(tmp, "config.json"), "w") as f:
         f.write(config_json)
+    if extra_state is not None:
+        with open(os.path.join(tmp, "extra_state.msgpack"), "wb") as f:
+            f.write(serialization.to_bytes(jax.device_get(extra_state)))
     with open(os.path.join(tmp, "meta.json"), "w") as f:
         json.dump(meta, f)
     # Integrity manifest, inside the tmp dir so the rename commits data and
@@ -194,6 +206,22 @@ def _load_checkpoint(train_dir: str, step: int, target: Any,
         print(f"[ckpt] migrated legacy checkpoint layout at step {step} "
               f"({n_changed} tree nodes rewritten)")
     return state, meta, config_json
+
+
+def load_extra_state(train_dir: str, step: int) -> Optional[Any]:
+    """Restore the auxiliary pytree committed by ``save_checkpoint(...,
+    extra_state=...)`` at ``step``, or None when that checkpoint carries
+    none (older checkpoints, or runs without auxiliary state). Integrity
+    is manifest-checked like the main payload: the extra file rode the
+    same atomic rename, so a committed checkpoint either has a verified
+    copy or none at all."""
+    path = checkpoint_path(train_dir, step)
+    fpath = os.path.join(path, "extra_state.msgpack")
+    if not os.path.exists(fpath):
+        return None
+    _check_manifest(path)
+    with open(fpath, "rb") as f:
+        return serialization.msgpack_restore(f.read())
 
 
 def latest_step(train_dir: str) -> Optional[int]:

@@ -116,7 +116,8 @@ class MultiSliceTrainer:
             num_aggregate=cfg.num_aggregate, compress=cfg.compress_grad,
             codec=cfg.grad_codec, codec_level=cfg.codec_level,
             wire_bucket_bytes=int(cfg.wire_bucket_mb * (1 << 20)),
-            wire_workers=cfg.wire_workers)
+            wire_workers=cfg.wire_workers,
+            topk_frac=cfg.grad_topk_frac, error_feedback=cfg.ef)
         from ps_pytorch_tpu.data.augment import input_norm_for
         self._input_norm = input_norm_for(cfg)
         self.grad_fns = [make_slice_grad_fn(self.model, m, self.has_bn,
@@ -231,11 +232,17 @@ class MultiSliceTrainer:
 
     def _checkpoint(self) -> None:
         from ps_pytorch_tpu.runtime import checkpoint as ckpt
+        # EF residuals are sender state: without them a resumed lossy-codec
+        # run re-sends error the accumulator had already banked, so the
+        # checkpoint carries them as extra state whenever EF is on.
+        extra = {"ef": self.aggregator.ef_state_dict()} \
+            if self.cfg.ef else None
         ckpt.save_checkpoint(self.cfg.train_dir, self.step,
                              jax.device_get(self._as_train_state()),
                              config_json=self.cfg.to_json(),
                              compress=self.cfg.compress_grad,
-                             codec_level=self.cfg.codec_level)
+                             codec_level=self.cfg.codec_level,
+                             extra_state=extra)
 
     def maybe_resume(self) -> bool:
         """Restore canonical params/opt state (and slice-0 BN stats; other
@@ -257,6 +264,9 @@ class MultiSliceTrainer:
         self.step = int(meta["step"])
         self._slice_params = [self.params] * self.n_slices
         self._slice_version = [self.step] * self.n_slices
+        extra = ckpt.load_extra_state(self.cfg.train_dir, step)
+        if extra and "ef" in extra:
+            self.aggregator.load_ef_state(extra["ef"])
         print(f"RESUME from {ckpt.checkpoint_path(self.cfg.train_dir, step)} "
               f"at step {self.step}")
         return True

@@ -43,6 +43,14 @@ encode/put/decode seconds + bytes, and the publish/read overlap fractions
     python -m ps_pytorch_tpu.tools.analyze wire /tmp/wire_spans.jsonl
     python -m ps_pytorch_tpu.tools.analyze wire trace.json --json
 
+Codec mode reads the same span timelines and reports the grad-codec byte
+accounting the wire now stamps on every encode: per-bucket raw (pre-codec)
+vs armoured (on-wire) bytes, the per-bucket and total compression ratios,
+and publish-level totals — how much of the wire cut each bucket earns:
+
+    python -m ps_pytorch_tpu.tools.analyze codec /tmp/wire_spans.jsonl
+    python -m ps_pytorch_tpu.tools.analyze codec trace.json --json
+
 Flight mode renders a flight-recorder crash dump (telemetry/flightrec.py)
 as a post-mortem: health events, recent steps/spans/events, and the final
 metric snapshot. Stitch mode merges per-process Chrome traces into one and
@@ -339,6 +347,78 @@ def wire_markdown(summary: dict) -> str:
                      + ("n/a (no pipelined sub-spans)" if v is None
                         else f"{v:.4f}"))
     return "\n".join(lines)
+
+
+def codec_summary(events: List[dict]) -> dict:
+    """wire_encode/wire_publish spans -> per-bucket compressed-vs-raw byte
+    accounting. Transport stamps every wire_encode span with ``bytes``
+    (armoured, post-codec) and ``bytes_raw`` (pre-codec float payload), so
+    a publish trace is enough to see where the wire's compression ratio
+    comes from — which buckets carry dense int8 lattices vs sparse index
+    payloads vs incompressible float residue."""
+    per_bucket: Dict[int, dict] = {}
+    publish = {"count": 0, "bytes": 0, "bytes_raw": 0}
+    for e in events:
+        args = e.get("args") or {}
+        if e["name"] == "wire_publish":
+            publish["count"] += 1
+            publish["bytes"] += int(args.get("bytes", 0))
+            publish["bytes_raw"] += int(args.get("bytes_raw", 0))
+            continue
+        if e["name"] != "wire_encode" or "bucket" not in args:
+            continue
+        b = per_bucket.setdefault(int(args["bucket"]),
+                                  {"bucket": int(args["bucket"]),
+                                   "encode_s": 0.0, "bytes": 0,
+                                   "bytes_raw": 0})
+        b["encode_s"] += e["dur"]
+        b["bytes"] += int(args.get("bytes", 0))
+        b["bytes_raw"] += int(args.get("bytes_raw", 0))
+
+    def ratio(raw: int, comp: int):
+        return round(raw / comp, 3) if comp > 0 and raw > 0 else None
+
+    buckets = [dict(per_bucket[k], encode_s=round(per_bucket[k]["encode_s"],
+                                                  6),
+                    ratio=ratio(per_bucket[k]["bytes_raw"],
+                                per_bucket[k]["bytes"]))
+               for k in sorted(per_bucket)]
+    tot_c = sum(b["bytes"] for b in buckets) or publish["bytes"]
+    tot_r = sum(b["bytes_raw"] for b in buckets) or publish["bytes_raw"]
+    return {"buckets": buckets, "publish": publish,
+            "total_bytes": tot_c, "total_bytes_raw": tot_r,
+            "total_ratio": ratio(tot_r, tot_c)}
+
+
+def codec_markdown(summary: dict) -> str:
+    lines = ["| bucket | encode | raw bytes | wire bytes | ratio |",
+             "|---|---|---|---|---|"]
+    for b in summary["buckets"]:
+        r = "n/a" if b["ratio"] is None else f"{b['ratio']:.3f}x"
+        lines.append(f"| {b['bucket']} | {b['encode_s']:.6f} s "
+                     f"| {b['bytes_raw']} | {b['bytes']} | {r} |")
+    r = summary["total_ratio"]
+    lines.append(f"\ntotal: {summary['total_bytes_raw']} raw -> "
+                 f"{summary['total_bytes']} on wire"
+                 + ("" if r is None else f" ({r:.3f}x)"))
+    return "\n".join(lines)
+
+
+def codec_main(args, parser) -> int:
+    files: List[str] = []
+    for pattern in args.runs:
+        files.extend(sorted(glob.glob(pattern)) or
+                     parser.error(f"no files match {pattern!r}") or [])
+    events = [e for path in files for e in read_span_events(path)]
+    if not any(e["name"] in ("wire_encode", "wire_publish")
+               for e in events):
+        parser.error(f"no wire_encode/wire_publish spans in {files}")
+    summary = codec_summary(events)
+    if args.json:
+        print(json.dumps(summary))
+    else:
+        print(codec_markdown(summary))
+    return 0
 
 
 def wire_main(args, parser) -> int:
@@ -844,6 +924,9 @@ def main(argv=None) -> int:
     if args.runs[0] == "wire":
         args.runs = args.runs[1:] or p.error("wire mode needs FILE...")
         return wire_main(args, p)
+    if args.runs[0] == "codec":
+        args.runs = args.runs[1:] or p.error("codec mode needs FILE...")
+        return codec_main(args, p)
     if args.runs[0] == "serving":
         args.runs = args.runs[1:] or p.error("serving mode needs FILE...")
         return serving_main(args, p)

@@ -7,6 +7,10 @@ Families and their watched metrics (direction, relative tolerance):
 
 - ``wire``       BENCH_WIRE_r*.json     publish_s/read_s/total_s lower-is-
                                         better, 20% (host RTT noise)
+- ``wire_codec`` BENCH_WIRE_r*.json     wire_codec_win_* rows: per-row ok,
+                                        topk wire_ratio >= 2.0, int8lat
+                                        bitwise_identical (bars travel in
+                                        the artifact; no prior round)
 - ``serve``      BENCH_SERVE_r*.json    tokens_per_sec higher-is-better,
                                         ttft_p99_ms/latency_p99_ms lower,
                                         25% (tail percentiles are noisy)
@@ -53,6 +57,18 @@ FAMILIES: Dict[str, dict] = {
         "metrics": [("publish_s", "lower", 0.20),
                     ("read_s", "lower", 0.20),
                     ("total_s", "lower", 0.20)],
+    },
+    "wire_codec": {
+        # Same artifact series as wire, but gating the homomorphic grad-
+        # codec rows (bench_suite wire_codec_* + derived wire_codec_win_*):
+        # every win row must be ok, topk@0.01 must cut wire bytes >= 2x vs
+        # the blosc decode-then-average baseline, and the int8lat
+        # compressed-domain average must be bitwise-identical to the
+        # oracle. No prior round needed — the bars travel in the rows.
+        "pattern": "BENCH_WIRE_r[0-9]*.json",
+        "metrics": [],              # invariant check, see _check_wire_codec
+        "min_ratio": [("wire_codec_win_topk_24mb", "wire_ratio", 2.0)],
+        "bitwise_rows": ["wire_codec_win_int8lat_24mb"],
     },
     "serve": {
         "pattern": "BENCH_SERVE_r[0-9]*.json",
@@ -126,6 +142,10 @@ def load_artifact(path: str):
         return rows
 
 
+def _as_rows(doc) -> List[dict]:
+    return [doc] if isinstance(doc, dict) else list(doc)
+
+
 def _by_config(rows) -> Dict[str, dict]:
     if isinstance(rows, dict):
         rows = [rows]
@@ -157,6 +177,8 @@ def compare(family: str, baseline, candidate) -> dict:
         return _check_ops(spec, candidate)
     if family == "slo":
         return _check_slo(spec, candidate)
+    if family == "wire_codec":
+        return _check_wire_codec(spec, candidate)
     base_rows, cand_rows = _by_config(baseline), _by_config(candidate)
     configs: Dict[str, dict] = {}
     ok = True
@@ -239,6 +261,41 @@ def _check_slo(spec: dict, candidate) -> dict:
     return {"family": "slo", "ok": ok, "configs": configs}
 
 
+def _check_wire_codec(spec: dict, candidate) -> dict:
+    """Gate the homomorphic-codec win rows: every wire_codec_win_* row's
+    own ok bit, the topk wire-bytes floor, and int8lat bitwise identity."""
+    rows = _by_config(candidate)
+    win_rows = {n: r for n, r in rows.items()
+                if n.startswith("wire_codec_win_")}
+    configs: Dict[str, dict] = {}
+    ok = True
+    if not win_rows:
+        return {"family": "wire_codec", "ok": False,
+                "configs": {"_empty": {"ok": False,
+                                       "note": "no wire_codec_win_* rows"}}}
+    for name, row in sorted(win_rows.items()):
+        checks = {"ok": {"cand": row.get("ok"), "ok": row.get("ok") is True}}
+        configs[name] = {"ok": checks["ok"]["ok"], "metrics": checks}
+        ok = ok and configs[name]["ok"]
+    for name, metric, floor in spec["min_ratio"]:
+        row = rows.get(name)
+        val = float(row.get(metric, 0.0)) if row else 0.0
+        check = {"cand": val, "floor": floor, "ok": val >= floor}
+        configs.setdefault(name, {"ok": True, "metrics": {}})
+        configs[name]["metrics"][metric] = check
+        configs[name]["ok"] = configs[name]["ok"] and check["ok"]
+        ok = ok and check["ok"]
+    for name in spec["bitwise_rows"]:
+        row = rows.get(name)
+        cand = row.get("bitwise_identical") if row else None
+        check = {"cand": cand, "ok": cand is True}
+        configs.setdefault(name, {"ok": True, "metrics": {}})
+        configs[name]["metrics"]["bitwise_identical"] = check
+        configs[name]["ok"] = configs[name]["ok"] and check["ok"]
+        ok = ok and check["ok"]
+    return {"family": "wire_codec", "ok": ok, "configs": configs}
+
+
 def _check_resilience(spec: dict, candidate) -> dict:
     doc = candidate if isinstance(candidate, dict) else \
         (candidate[0] if candidate else {})
@@ -298,7 +355,7 @@ def run_gate(family: str, candidate_path: str, repo: str = ".",
     against its predecessor."""
     candidate = load_artifact(candidate_path)
     baseline = None
-    if family not in ("resilience", "ops", "slo"):
+    if family not in ("resilience", "ops", "slo", "wire_codec"):
         if baseline_path:
             baseline = load_artifact(baseline_path)
         else:
@@ -340,6 +397,19 @@ def run_all(repo: str = ".") -> dict:
                                             "section; skipped"}
                 continue
             families[family] = run_gate(family, with_section[-1], repo=repo)
+        elif family == "wire_codec":
+            # Gate the newest wire artifact that carries codec win rows
+            # (older BENCH_WIRE rounds predate the homomorphic family).
+            with_rows = [p for p in paths
+                         if any(str(r.get("config", "")).startswith(
+                             "wire_codec_win_")
+                             for r in _as_rows(load_artifact(p)))]
+            if not with_rows:
+                families[family] = {"family": family, "ok": True,
+                                    "note": "no artifact with "
+                                            "wire_codec_win_* rows; skipped"}
+                continue
+            families[family] = run_gate(family, with_rows[-1], repo=repo)
         elif family in ("resilience", "ops", "slo"):
             families[family] = run_gate(family, paths[-1], repo=repo)
         elif len(paths) < 2:

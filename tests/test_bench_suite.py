@@ -73,4 +73,36 @@ def test_wire_bench_pair_bitwise_identical(tmp_path):
     assert s["stages"]["wire_encode"]["count"] == 2     # one per bucket
     assert s["stages"]["wire_decode"]["count"] == 2
     assert len(s["buckets"]) == 2
-    assert s["publish_overlap_fraction"] is not None
+
+
+def test_codec_agg_bench_rows(tmp_path):
+    """Tiny homomorphic-codec aggregation rows: int8lat's compressed-domain
+    average is bitwise-identical to the decode-then-average oracle, the
+    sparsifiers cut wire bytes hard, and the trace dump feeds the analyze
+    codec mode."""
+    from bench_suite import bench_codec_agg
+
+    base = bench_codec_agg("cb", 1, codec="blosc", payload_mb=2,
+                           leaf_kb=256, contributors=3, rtt_ms=0.1,
+                           bucket_mb=0.5, workers=2)
+    assert base["bitwise_identical"] is None            # lossless baseline
+    assert base["agg_rel_err"] == 0.0
+
+    trace = tmp_path / "codec_spans.jsonl"
+    int8 = bench_codec_agg("ci", 1, codec="int8lat", payload_mb=2,
+                           leaf_kb=256, contributors=3, rtt_ms=0.1,
+                           bucket_mb=0.5, workers=2, trace_out=str(trace))
+    assert int8["bitwise_identical"] is True
+    assert int8["wire_mb"] < base["wire_mb"] / 2        # ~4x int8 cut
+
+    topk = bench_codec_agg("ct", 1, codec="topk", payload_mb=2,
+                           leaf_kb=256, contributors=3, frac=0.01,
+                           rtt_ms=0.1, bucket_mb=0.5, workers=2)
+    assert topk["bitwise_identical"] is True            # same adds per slot
+    assert topk["wire_mb"] * 10 < base["wire_mb"]       # ~2% of raw kept
+
+    from ps_pytorch_tpu.tools.analyze import codec_summary, read_span_events
+    s = codec_summary(read_span_events(str(trace)))
+    assert len(s["buckets"]) >= 2
+    assert s["total_bytes_raw"] > 0 and s["total_ratio"] is not None
+    assert s["publish"]["bytes"] == sum(b["bytes"] for b in s["buckets"])

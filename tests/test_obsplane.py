@@ -515,6 +515,43 @@ def test_regress_missing_config_and_higher_better(tmp_path):
     assert run_gate("serve", str(cand), repo=str(tmp_path))["ok"] is True
 
 
+def test_regress_wire_codec_family(tmp_path):
+    """wire_codec family: gates the homomorphic-codec win rows on their own
+    ok bits, the topk wire-bytes floor, and int8lat bitwise identity — no
+    prior round needed (the bars travel in the artifact)."""
+    from ps_pytorch_tpu.tools.regress import run_gate
+
+    def rows(topk_ratio=45.0, int8_bitwise=True, int8_ok=True):
+        return [
+            {"config": "wire_codec_blosc_24mb", "wire_mb": 90.0},
+            {"config": "wire_codec_win_topk_24mb", "wire_ratio": topk_ratio,
+             "bitwise_identical": True, "ok": topk_ratio >= 2.0},
+            {"config": "wire_codec_win_int8lat_24mb", "wire_ratio": 3.5,
+             "bitwise_identical": int8_bitwise, "ok": int8_ok},
+        ]
+
+    cand = tmp_path / "cand.json"
+    _write(cand, rows())
+    assert run_gate("wire_codec", str(cand), repo=str(tmp_path))["ok"]
+    # topk below the 2x wire floor fails even with its own ok forced true.
+    bad = rows(topk_ratio=1.5)
+    bad[1]["ok"] = True
+    _write(cand, bad)
+    v = run_gate("wire_codec", str(cand), repo=str(tmp_path))
+    assert not v["ok"]
+    m = v["configs"]["wire_codec_win_topk_24mb"]["metrics"]["wire_ratio"]
+    assert m["ok"] is False and m["floor"] == 2.0
+    # A lossy "lossless" int8lat path is a broken path.
+    _write(cand, rows(int8_bitwise=False, int8_ok=False))
+    v = run_gate("wire_codec", str(cand), repo=str(tmp_path))
+    assert not v["ok"]
+    assert v["configs"]["wire_codec_win_int8lat_24mb"]["metrics"][
+        "bitwise_identical"]["ok"] is False
+    # An artifact without codec win rows cannot pass this family.
+    _write(cand, [{"config": "wire_overlapped_8mb", "publish_s": 0.1}])
+    assert not run_gate("wire_codec", str(cand), repo=str(tmp_path))["ok"]
+
+
 def test_regress_resilience_and_ops_families(tmp_path):
     from ps_pytorch_tpu.tools.regress import run_gate
 

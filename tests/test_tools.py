@@ -112,6 +112,42 @@ def test_analyze_wire_summary_and_cli(tmp_path, capsys):
     assert "| wire_put | 2 |" in out
 
 
+def test_analyze_codec_summary_and_cli(tmp_path, capsys):
+    """codec mode: per-bucket raw-vs-wire byte accounting from the
+    bytes/bytes_raw args transport stamps on wire_encode spans."""
+    from ps_pytorch_tpu.tools import analyze
+
+    spans = [
+        {"name": "wire_publish", "t0": 0.0, "dur": 0.5,
+         "args": {"bytes": 1500, "bytes_raw": 6000}},
+        {"name": "wire_encode", "t0": 0.0, "dur": 0.3,
+         "args": {"bucket": 0, "bytes": 1000, "bytes_raw": 4000}},
+        {"name": "wire_encode", "t0": 0.1, "dur": 0.2,
+         "args": {"bucket": 1, "bytes": 500, "bytes_raw": 2000}},
+        {"name": "wire_put", "t0": 0.3, "dur": 0.3,
+         "args": {"bucket": 0, "bytes": 1000}},   # put spans: not counted
+    ]
+    p = tmp_path / "spans.jsonl"
+    p.write_text("\n".join(json.dumps(s) for s in spans))
+    s = analyze.codec_summary(analyze.read_span_events(str(p)))
+    assert [b["bucket"] for b in s["buckets"]] == [0, 1]
+    assert s["buckets"][0]["ratio"] == pytest.approx(4.0)
+    assert s["total_bytes"] == 1500 and s["total_bytes_raw"] == 6000
+    assert s["total_ratio"] == pytest.approx(4.0)
+    assert s["publish"]["count"] == 1
+    # Blocking wire: no bucketed encode spans -> publish totals carry it.
+    blk = tmp_path / "blocking.jsonl"
+    blk.write_text(json.dumps(spans[0]))
+    s2 = analyze.codec_summary(analyze.read_span_events(str(blk)))
+    assert s2["buckets"] == [] and s2["total_ratio"] == pytest.approx(4.0)
+
+    from ps_pytorch_tpu.tools.analyze import main as analyze_main
+    assert analyze_main(["codec", str(p)]) == 0
+    out = capsys.readouterr().out
+    assert "| 0 | 0.300000 s | 4000 | 1000 | 4.000x |" in out
+    assert "total: 6000 raw -> 1500 on wire (4.000x)" in out
+
+
 # ------------------------------------------------------------------ sweep --
 
 TRAIN_ARGS = ["--network", "LeNet", "--dataset", "synthetic_mnist",

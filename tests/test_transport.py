@@ -113,6 +113,7 @@ def test_wire_stats_count_armoured_bytes():
     writer = KVGradientTransport(kv, 1, tpl, tpl, run_id="r")
     reader = KVGradientTransport(kv, 1, tpl, tpl, run_id="r")
     assert writer.wire_stats() == {"wire_bytes_out": 0, "wire_bytes_in": 0,
+                                   "wire_raw_bytes_out": 0,
                                    "param_publishes": 0,
                                    "last_param_publish_bytes": 0,
                                    "wire_read_errors": 0}
@@ -120,6 +121,10 @@ def test_wire_stats_count_armoured_bytes():
     writer.publish_params(1, _tree(2))
     st = writer.wire_stats()
     assert st["wire_bytes_out"] > 0
+    # Pre-codec accounting: raw bytes = the float32 payload both publishes
+    # carried, independent of what the codec made of them.
+    assert st["wire_raw_bytes_out"] == 2 * sum(
+        np.asarray(l).nbytes for l in jax.tree.leaves(_tree(0)))
     assert st["param_publishes"] == 1
     assert 0 < st["last_param_publish_bytes"] <= st["wire_bytes_out"]
     # Reader side: bytes_in grows by what it actually read back.
