@@ -752,28 +752,40 @@ class LatencyKV:
     which would hide exactly the put/get legs the overlapped wire
     pipelines. ``time.sleep`` releases the GIL, so overlapping these waits
     with encode/decode on worker threads is the same concurrency a real
-    in-flight RPC provides. ``rtt_s`` is recorded in the bench row."""
+    in-flight RPC provides. ``rtt_s`` is recorded in the bench row.
 
-    def __init__(self, inner, rtt_s: float):
+    ``classes`` upgrades the flat RTT to PER-LINK latency: a list of
+    ``(key_prefix, rtt_s)`` pairs, first match wins, flat ``rtt_s`` as the
+    fallback. That is the 2-tier DCN model the hierarchy bench needs —
+    intra-group keys ride a fast link, inter-region up-links a slow one —
+    and it mirrors how the fault plane scopes ``link_jitter:prefix=``."""
+
+    def __init__(self, inner, rtt_s: float, classes=None):
         self.inner = inner
         self.rtt_s = rtt_s
+        self.classes = list(classes or [])
         self.ops = 0
 
-    def _wait(self):
+    def _wait(self, key=""):
         self.ops += 1
-        if self.rtt_s > 0:
-            time.sleep(self.rtt_s)
+        rtt = self.rtt_s
+        for prefix, class_rtt in self.classes:
+            if key.startswith(prefix):
+                rtt = class_rtt
+                break
+        if rtt > 0:
+            time.sleep(rtt)
 
     def set(self, key, value):
-        self._wait()
+        self._wait(key)
         self.inner.set(key, value)
 
     def get(self, key, default=None):
-        self._wait()
+        self._wait(key)
         return self.inner.get(key, default)
 
     def delete(self, key):
-        self._wait()
+        self._wait(key)
         self.inner.delete(key)
 
 
@@ -995,6 +1007,116 @@ def bench_codec_agg(name, steps, *, codec="int8lat", payload_mb=24,
             for s in tracer.spans():
                 f.write(json.dumps(s) + "\n")
     return row
+
+
+def bench_hier_agg(name, steps, *, codec="int8lat", payload_mb=8,
+                   leaf_kb=512, n_slices=4, group_size=2, frac=0.01,
+                   intra_rtt_ms=1.0, inter_rtt_ms=30.0):
+    """Flat star vs 2-tier hierarchy over a per-link-latency DCN model
+    (parallel/hierarchy.py). The LatencyKV classes give intra-group keys a
+    fast link and everything crossing regions a slow one — the geometry
+    where a tree pays off: flat ships ``n_slices`` payloads over the slow
+    link, the hierarchy ships ``n_groups`` re-encoded group aggregates
+    (members ride the fast link). ``rel_err`` pins the hier average
+    against the flat compressed-domain average — the re-encode hop may
+    round to the codec lattice, so this is a tolerance, not bitwise."""
+    from ps_pytorch_tpu.compression.codecs import encode_leaves, is_payload
+    from ps_pytorch_tpu.parallel.async_dp import StaleGradientAggregator
+    from ps_pytorch_tpu.parallel.hierarchy import (
+        GroupAggregator, HierarchyPlan, RootAggregator,
+    )
+    from ps_pytorch_tpu.parallel.transport import KVPytreeChannel
+    from ps_pytorch_tpu.runtime.coordinator import KVStore
+
+    plan = HierarchyPlan(n_slices, group_size)
+    n_leaves = max(int(payload_mb * 1024 // leaf_kb), 1)
+    per_leaf = int(leaf_kb * 1024 // 4)
+    rng = np.random.default_rng(11)
+    trees = [{f"l{i:04d}": rng.normal(size=(per_leaf,))
+              .astype(np.float32) / 4.0 for i in range(n_leaves)}
+             for _ in range(n_slices)]
+    leaves0, treedef = jax.tree.flatten(trees[0])
+    template = jax.tree.unflatten(treedef, encode_leaves(
+        codec, [np.zeros_like(l) for l in leaves0],
+        slice_id=0, step=0, frac=frac))
+    payloads = [encode_leaves(codec, jax.tree.leaves(t), slice_id=w,
+                              step=1, frac=frac)
+                for w, t in enumerate(trees)]
+    wire_trees = [jax.tree.unflatten(treedef, p) for p in payloads]
+    classes = [("bench/hgrad/", intra_rtt_ms / 1e3)]
+
+    def clock_kv():
+        # Everything not intra-group (flat star legs AND hier up-links)
+        # crosses regions at the slow RTT.
+        return LatencyKV(KVStore(), inter_rtt_ms / 1e3, classes=classes)
+
+    flat_s = hier_s = 0.0
+    flat_avg = hier_avg = None
+    flat_slow = hier_slow = None
+    reps = max(min(steps, 3), 1)
+    for rep in range(reps):
+        # -- flat star: n_slices payloads over the slow link ------------
+        kv = clock_kv()
+        t0 = time.perf_counter()
+        for w, tree in enumerate(wire_trees):
+            KVPytreeChannel(kv, f"bench/flat/{w}", template,
+                            codec="blosc").publish(1, tree)
+        agg = StaleGradientAggregator(n_slices, staleness_limit=4,
+                                      num_aggregate=0, compress=True,
+                                      codec=codec, topk_frac=frac)
+        for w in range(n_slices):
+            got = KVPytreeChannel(kv, f"bench/flat/{w}", template,
+                                  codec="blosc").read()
+            agg.submit_encoded(w, 1, got[1])
+        avg, _ = agg.collect(1)
+        flat_s += time.perf_counter() - t0
+        if rep == 0:
+            flat_avg = [np.asarray(l) for l in jax.tree.leaves(avg)]
+            flat_slow = kv.ops
+
+        # -- 2-tier: members ride the fast link, one re-encoded payload
+        #    per group crosses regions --------------------------------
+        kv = clock_kv()
+        t0 = time.perf_counter()
+        for w, tree in enumerate(wire_trees):
+            gid = plan.group_of(w)
+            KVPytreeChannel(kv, f"bench/hgrad/{gid}/{w}", template,
+                            codec="blosc").publish(1, tree)
+        root = RootAggregator(plan.n_groups, codec, staleness_limit=4)
+        for gid in range(plan.n_groups):
+            ga = GroupAggregator(plan, gid, codec, staleness_limit=4,
+                                 topk_frac=frac)
+            for sid in plan.members(gid):
+                got = KVPytreeChannel(kv, f"bench/hgrad/{gid}/{sid}",
+                                      template, codec="blosc").read()
+                ga.submit_encoded(sid, 1, got[1])
+            step, wsum, up = ga.collect_and_reencode(1)
+            KVPytreeChannel(kv, f"bench/hagg/{gid}", template,
+                            codec="blosc").publish(
+                                1, up, meta={"wsum": wsum})
+        for gid in range(plan.n_groups):
+            got = KVPytreeChannel(kv, f"bench/hagg/{gid}", template,
+                                  codec="blosc").read()
+            root.submit_group(gid, 1, float(got[2]["wsum"]), got[1])
+        avg, _ = root.collect(1)
+        hier_s += time.perf_counter() - t0
+        if rep == 0:
+            hier_avg = [np.asarray(l) for l in
+                        jax.tree.leaves(avg, is_leaf=is_payload)]
+            hier_slow = kv.ops
+    num = sum(float(np.sum((h.reshape(f.shape) - f) ** 2))
+              for h, f in zip(hier_avg, flat_avg))
+    den = sum(float(np.sum(f ** 2)) for f in flat_avg)
+    rel_err = round((num / max(den, 1e-30)) ** 0.5, 6)
+    return {"config": name, "platform": "host", "grad_codec": codec,
+            "n_slices": n_slices, "group_size": plan.group_size,
+            "n_groups": plan.n_groups, "payload_mb": payload_mb,
+            "intra_rtt_ms": intra_rtt_ms, "inter_rtt_ms": inter_rtt_ms,
+            "flat_s": round(flat_s / reps, 3),
+            "hier_s": round(hier_s / reps, 3),
+            "speedup": round(flat_s / max(hier_s, 1e-9), 3),
+            "flat_kv_ops": flat_slow, "hier_kv_ops": hier_slow,
+            "rel_err": rel_err, "steps": reps}
 
 
 def bench_ops_overhead(name, steps, *, batch=256, reps=3):
@@ -1282,6 +1404,16 @@ CONFIGS = {
     # cost per step when no faults fire; same <2% posture as ops_overhead.
     "elastic_overhead": lambda steps: bench_elastic_overhead(
         "elastic_overhead", max(steps, 30)),
+    # -- hierarchical multi-hop sync (ISSUE 11, parallel/hierarchy.py):
+    # flat star vs 2-tier tree over the per-link LatencyKV (fast
+    # intra-group, 20-50 ms inter-region). Each row carries BOTH legs;
+    # main() derives hierarchy_win_* (acceptance: hier beats flat at
+    # >= 3 slices). --
+    "hier_sync_4slice": lambda steps: bench_hier_agg(
+        "hier_sync_4slice", min(steps, 3), n_slices=4, group_size=2),
+    "hier_sync_9slice": lambda steps: bench_hier_agg(
+        "hier_sync_9slice", min(steps, 2), n_slices=9, group_size=3,
+        payload_mb=4),
 }
 
 
@@ -1431,6 +1563,23 @@ def main(argv=None) -> int:
                                  and wire_ratio >= 2.0)
             print(json.dumps(out), flush=True)
             rows.append(out)
+
+    # Hierarchical sync: each hier_sync_* row already carries both legs at
+    # the same geometry/link model; the derived row states the acceptance
+    # bar (ISSUE 11): the tree must beat the flat star once >= 3 slices
+    # share the slow link, with the hier average inside codec tolerance.
+    for row in list(rows):
+        cfg_name = row.get("config", "")
+        if not cfg_name.startswith("hier_sync_") or "error" in row:
+            continue
+        out = {"config": f"hierarchy_win_{cfg_name[len('hier_sync_'):]}",
+               "n_slices": row["n_slices"], "n_groups": row["n_groups"],
+               "flat_s": row["flat_s"], "hier_s": row["hier_s"],
+               "speedup": row["speedup"], "rel_err": row["rel_err"],
+               "ok": bool(row["n_slices"] >= 3 and row["speedup"] > 1.0
+                          and row["rel_err"] < 0.05)}
+        print(json.dumps(out), flush=True)
+        rows.append(out)
 
     # Serving: batched (8 slots) vs sequential (1 slot) aggregate
     # tokens/sec at 8 concurrent requests, AND the two runs' sampled tokens

@@ -69,6 +69,14 @@ class TrainConfig:
     sync_batchnorm: bool = False     # reference keeps BN stats worker-local (distributed_worker.py:245-252)
     shard_update: bool = False       # ZeRO-1 cross-replica sharded weight update (parallel/zero.py)
 
+    # -- hierarchical sync (parallel/hierarchy.py: 2-tier multi-hop
+    #    aggregation over the coordination KV; flat = the star topology) --
+    sync_topology: str = "flat"      # flat | hier (hier requires compress_grad + a homomorphic grad_codec: hops sum in the compressed domain)
+    sync_group_size: int = 0         # members per intra-group tier; 0 = auto (~sqrt of slice count)
+    sync_intra_every: int = 1        # member -> group-aggregator hop every N member steps (fast intra-slice link)
+    sync_inter_every: int = 1        # group -> root hop every N group rounds (slow inter-region link; raise to amortize WAN RTTs)
+    hier_hop_retries: int = 3        # jittered retry attempts per upward hop before the hop is skipped (degraded, never fatal)
+
     # -- numerics / TPU --
     compute_dtype: str = "bfloat16"  # MXU-native compute dtype; params stay float32
     device_normalize: bool = True    # loaders ship raw uint8; the jitted step normalizes in-graph (4x less host->device traffic)
@@ -267,6 +275,33 @@ class TrainConfig:
         if not 0.0 <= self.reqtrace_sample <= 1.0:
             raise ValueError(f"reqtrace_sample={self.reqtrace_sample} "
                              "(must be in [0, 1])")
+        if self.sync_topology not in ("flat", "hier"):
+            raise ValueError(f"unknown sync_topology {self.sync_topology!r} "
+                             "(flat | hier)")
+        if self.sync_topology == "hier":
+            # Intra-group aggregators sum member payloads in the compressed
+            # domain and re-encode once per hop — only the homomorphic
+            # codecs support that; reject at config time, not mid-hop.
+            from ps_pytorch_tpu.compression.codecs import (
+                HOMOMORPHIC_GRAD_CODECS,
+            )
+            if not self.compress_grad or \
+                    self.grad_codec not in HOMOMORPHIC_GRAD_CODECS:
+                raise ValueError(
+                    "sync_topology=hier requires compress_grad=True and a "
+                    f"homomorphic grad_codec "
+                    f"({' | '.join(HOMOMORPHIC_GRAD_CODECS)}), got "
+                    f"compress_grad={self.compress_grad} "
+                    f"grad_codec={self.grad_codec!r}")
+        if self.sync_group_size < 0:
+            raise ValueError(f"sync_group_size={self.sync_group_size} "
+                             "(must be >= 0; 0 = auto)")
+        if self.sync_intra_every < 1 or self.sync_inter_every < 1:
+            raise ValueError("sync_intra_every / sync_inter_every must be "
+                             ">= 1")
+        if self.hier_hop_retries < 1:
+            raise ValueError(f"hier_hop_retries={self.hier_hop_retries} "
+                             "(must be >= 1; 1 = no retries)")
         if self.mode == "async" and self.publish_every > max(self.staleness_limit, 1):
             # Followers only ever see published versions: a publish gap
             # wider than the staleness window makes EVERY follower gradient

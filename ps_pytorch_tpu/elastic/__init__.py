@@ -25,7 +25,7 @@ DistributedKV in the chaos drills.
 """
 
 from ps_pytorch_tpu.elastic.election import (  # noqa: F401
-    Deposed, ElectionFailed, LeaderElection,
+    Deposed, ElectionFailed, LeaderElection, group_election,
 )
 from ps_pytorch_tpu.elastic.membership import (  # noqa: F401
     MemberAnnouncer, MembershipRegistry, read_view,

@@ -261,3 +261,15 @@ class LeaderElection:
         out = dict(self.stats)
         out["epoch"] = self.epoch
         return out
+
+
+def group_election(kv, run_id: str, gid: int, pid: int, n_processes: int,
+                   preferred: int, **kw) -> LeaderElection:
+    """A group-scoped election for the hierarchical sync plane: same
+    machinery, namespaced lease (``{run_id}/g{gid}/elect/...``) so each
+    sync group elects its aggregator independently. Candidacy keys are
+    only ever written by group members (non-members never construct this
+    object), and the campaign's range(n) scan simply finds no candidates
+    outside the group — global pids work unchanged."""
+    return LeaderElection(kv, f"{run_id}/g{gid}", pid, n_processes,
+                          preferred=preferred, **kw)

@@ -228,6 +228,12 @@ class StaleGradientAggregator:
             ef.load_state_dict(d)
             self._ef[int(sid)] = ef
 
+    def pending(self) -> Dict[int, int]:
+        """{slice_id: step} of every pooled contribution — the hierarchy's
+        group aggregators (and tests) read this to see who has reported
+        without consuming anything."""
+        return {sid: step for sid, (step, _, _) in self._pool.items()}
+
     def wire_bytes(self) -> int:
         """Bytes currently pooled (what crossed / would cross DCN)."""
         from ps_pytorch_tpu.compression.codecs import payload_nbytes

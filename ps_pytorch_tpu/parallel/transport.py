@@ -112,6 +112,7 @@ class KVPytreeChannel:
         self.last_publish_bucket_bytes: List[int] = []  # armoured, per bucket
         self.publishes = 0
         self.read_errors = 0        # transient read failures tolerated
+        self.publish_errors = 0     # transient publish failures absorbed
         self._pool: Optional[ThreadPoolExecutor] = None
 
     def _executor(self) -> ThreadPoolExecutor:
@@ -151,6 +152,22 @@ class KVPytreeChannel:
             # worker has committed its chunks.
             self.kv.set(f"{self.prefix}/ver", str(version))
             self._gc(version - 2)
+
+    def try_publish(self, version: int, tree: Any,
+                    meta: Optional[dict] = None) -> bool:
+        """Publish, absorbing TRANSIENT coordination-service errors as a
+        False return (counted in ``publish_errors``) instead of raising.
+        The hierarchical sync plane uses this on every hop: a partitioned
+        member/aggregator must degrade (skip the hop, try next round), not
+        crash the process. Structural errors still raise."""
+        try:
+            self.publish(version, tree, meta)
+            return True
+        except Exception as e:
+            if not is_retryable(e):
+                raise
+            self.publish_errors += 1
+            return False
 
     def _put_serial(self, version: int, leaves: List[Any]):
         """Legacy blocking wire: leaf-at-a-time encode+put, byte-exact with

@@ -47,6 +47,19 @@ Spec grammar (``;``-separated faults, each ``kind:key=val,key=val``):
         plane, lease timeouts, and elections are what must absorb it.
         The injector learns the current step from ``maybe_crash`` (called
         at the top of every step loop).
+    kv_partition:group=1,gsize=2,step=5,steps=4
+        Subtree scope for the hierarchical sync plane: instead of naming
+        raw ranks with ``r=``, name a contiguous sync group — the fault
+        fires for every process with ``process_index // gsize == group``
+        (``gsize`` defaults to 2). The same spec string can be armed on
+        every process; it self-scopes to the partitioned subtree.
+    link_jitter:s=0.02[,prefix=hagg][,p=0.5,seed=3][,op=...]
+        Per-LINK delay: matching KV ops whose KEY starts with ``prefix``
+        sleep ``s`` seconds (always, or with probability ``p`` when
+        given). Because hierarchy traffic is key-namespaced per hop
+        (``.../hgrad/<gid>/...`` intra-group, ``.../hagg/<gid>`` up-links),
+        a prefix models one slow link without touching the others — the
+        WAN-edge half of the multi-hop failure model.
 
 Drop/delay decisions come from ``numpy.default_rng(seed + 10007 * pid)``:
 reproducible per process, uncorrelated across processes.
@@ -58,7 +71,7 @@ from typing import Any, Callable, Dict, List, Optional
 import numpy as np
 
 _KINDS = ("kv_drop", "kv_delay", "replica_crash", "ckpt_corrupt", "grad_nan",
-          "leader_kill", "kv_partition")
+          "leader_kill", "kv_partition", "link_jitter")
 _KV_OPS = ("set", "get", "delete")
 
 
@@ -166,6 +179,21 @@ def _validate(p: Dict[str, Any], part: str) -> None:
         if not isinstance(p.setdefault("steps", 1), int) or p["steps"] < 1:
             raise ValueError(f"kv_partition needs steps=<int >= 1> "
                              f"(got {part!r})")
+        if "group" in p:
+            # Subtree scope: membership is derived per process as
+            # process_index // gsize == group, so one spec string arms
+            # everywhere and self-scopes to the partitioned sync group.
+            if not isinstance(p["group"], int) or p["group"] < 0:
+                raise ValueError(f"kv_partition group must be an int >= 0 "
+                                 f"(got {part!r})")
+            if not isinstance(p.setdefault("gsize", 2), int) or \
+                    p["gsize"] < 1:
+                raise ValueError(f"kv_partition gsize must be an int >= 1 "
+                                 f"(got {part!r})")
+            if "r" in p:
+                raise ValueError(f"kv_partition takes r= or group=, not "
+                                 f"both (got {part!r})")
+            return
         # r: one process (int) or a '+'-separated subset ("1+2"); parsed
         # into a list here so the window check is a plain membership test.
         r = p.setdefault("r", 0)
@@ -180,6 +208,20 @@ def _validate(p: Dict[str, Any], part: str) -> None:
         else:
             raise ValueError(f"kv_partition r must be an int or "
                              f"'+'-separated ints (got {part!r})")
+    elif kind == "link_jitter":
+        s = p.get("s")
+        if not isinstance(s, (int, float)) or s <= 0:
+            raise ValueError(f"link_jitter needs s=<seconds > 0> "
+                             f"(got {part!r})")
+        if "p" in p and (not isinstance(p["p"], (int, float))
+                         or not 0 <= p["p"] <= 1):
+            raise ValueError(f"link_jitter p must be in [0,1] (got {part!r})")
+        if "prefix" in p and not isinstance(p["prefix"], str):
+            raise ValueError(f"link_jitter prefix must be a string "
+                             f"(got {part!r})")
+        if "op" in p and p["op"] not in _KV_OPS:
+            raise ValueError(f"link_jitter op must be one of {_KV_OPS} "
+                             f"(got {part!r})")
 
 
 class FaultyKV:
@@ -201,13 +243,20 @@ class FaultyKV:
             int(f.get("seed", 0)) + 10007 * injector.process_index)
             for f in faults]
 
-    def _roll(self, op: str) -> None:
+    def _partitioned(self, f: Dict[str, Any]) -> bool:
+        """Is this process inside the fault's partition scope? ``r=`` names
+        raw ranks; ``group=`` names a contiguous sync group of ``gsize``."""
+        if "group" in f:
+            return self._inj.process_index // f["gsize"] == f["group"]
+        return self._inj.process_index in f["r"]
+
+    def _roll(self, op: str, key: str = "") -> None:
         for f, rng in zip(self._faults, self._rngs):
             if f["kind"] == "kv_partition":
                 # Total, deterministic, step-windowed: no dice roll. The
                 # injector's current_step advances at each step top
                 # (maybe_crash), so the window opens/closes with the loop.
-                if self._inj.process_index in f["r"] and \
+                if self._partitioned(f) and \
                         f["step"] <= self._inj.current_step < \
                         f["step"] + f["steps"]:
                     self._inj.counters["kv_partition_drops"] += 1
@@ -216,6 +265,17 @@ class FaultyKV:
                         f"(step {self._inj.current_step})")
                 continue
             if f.get("op") is not None and f["op"] != op:
+                continue
+            if f["kind"] == "link_jitter":
+                # Key-prefix-scoped delay: models ONE slow link in the
+                # hierarchy's key-namespaced topology. No prefix = every
+                # link; no p = deterministic (fires on every match).
+                if f.get("prefix") and not key.startswith(f["prefix"]):
+                    continue
+                if "p" in f and rng.random() >= f["p"]:
+                    continue
+                self._inj.counters["link_jitters"] += 1
+                self._sleep(float(f["s"]))
                 continue
             if rng.random() >= f["p"]:
                 continue
@@ -227,15 +287,15 @@ class FaultyKV:
             self._sleep(float(f["s"]))
 
     def set(self, key: str, value: str) -> None:
-        self._roll("set")
+        self._roll("set", key)
         self.inner.set(key, value)
 
     def get(self, key: str, default: Optional[str] = None) -> Optional[str]:
-        self._roll("get")
+        self._roll("get", key)
         return self.inner.get(key, default)
 
     def delete(self, key: str) -> None:
-        self._roll("delete")
+        self._roll("delete", key)
         self.inner.delete(key)
 
 
@@ -262,17 +322,19 @@ class FaultInjector:
         self.counters: Dict[str, int] = {
             "kv_drops": 0, "kv_delays": 0, "crashes": 0,
             "ckpt_corruptions": 0, "grad_nans": 0, "leader_kills": 0,
-            "kv_partition_drops": 0}
+            "kv_partition_drops": 0, "link_jitters": 0}
 
     # ---- KV plane ----
     @property
     def has_kv_faults(self) -> bool:
-        return any(f["kind"] in ("kv_drop", "kv_delay", "kv_partition")
+        return any(f["kind"] in ("kv_drop", "kv_delay", "kv_partition",
+                                 "link_jitter")
                    for f in self.faults)
 
     def wrap_kv(self, kv):
         kv_faults = [f for f in self.faults
-                     if f["kind"] in ("kv_drop", "kv_delay", "kv_partition")]
+                     if f["kind"] in ("kv_drop", "kv_delay", "kv_partition",
+                                      "link_jitter")]
         if not kv_faults:
             return kv
         return FaultyKV(kv, kv_faults, self, self.sleep)

@@ -45,6 +45,7 @@ from ps_pytorch_tpu.runtime.metrics import MetricsLogger
 from ps_pytorch_tpu.runtime.multislice import make_slice_grad_fn
 from ps_pytorch_tpu.telemetry import (
     MetricsExporter, Registry, Tracer, declare_elastic_metrics,
+    declare_hierarchy_metrics, declare_resilience_metrics,
     declare_training_metrics, device_memory_record, host_rss_bytes,
     set_default_tracer,
 )
@@ -177,11 +178,34 @@ class AsyncTrainer:
         # after it. 0 restores the blocking single-payload schedule.
         wire_bucket_bytes = int(cfg.wire_bucket_mb * (1 << 20))
         self._wire_overlap = wire_bucket_bytes > 0
-        self.transport = KVGradientTransport(
-            kv, self.n, grad_template=grad_template,
-            param_template=param_template, run_id=f"async-{cfg.seed}",
-            level=cfg.codec_level, codec=chan_codec,
-            bucket_bytes=wire_bucket_bytes, workers=cfg.wire_workers)
+        self._hier = cfg.sync_topology == "hier"
+        if self._hier:
+            # 2-tier multi-hop sync (parallel/hierarchy.py): members
+            # publish to key-namespaced intra-group channels, the group
+            # aggregator (a group-scoped elastic lease) re-encodes and
+            # publishes one payload per group upward, the root (PS leader)
+            # pools GROUP aggregates. Config validation already pinned
+            # compress_grad + a homomorphic codec.
+            from ps_pytorch_tpu.parallel.hierarchy import (
+                HierarchicalKVTransport,
+            )
+            self.transport = HierarchicalKVTransport(
+                kv, self.n, grad_template=grad_template,
+                param_template=param_template, run_id=f"async-{cfg.seed}",
+                pid=self.pid, group_size=cfg.sync_group_size,
+                codec=cfg.grad_codec, staleness_limit=cfg.staleness_limit,
+                topk_frac=cfg.grad_topk_frac, chan_codec=chan_codec,
+                level=cfg.codec_level, bucket_bytes=wire_bucket_bytes,
+                workers=cfg.wire_workers, hop_retries=cfg.hier_hop_retries,
+                lease_interval_s=cfg.leader_lease_s or 1.0)
+            print(f"HIER topology pid {self.pid}: "
+                  f"{self.transport.describe()}", flush=True)
+        else:
+            self.transport = KVGradientTransport(
+                kv, self.n, grad_template=grad_template,
+                param_template=param_template, run_id=f"async-{cfg.seed}",
+                level=cfg.codec_level, codec=chan_codec,
+                bucket_bytes=wire_bucket_bytes, workers=cfg.wire_workers)
 
         # Per-slice data: this process is shard pid-of-n over the shared-seed
         # shuffle; each slice draws cfg.batch_size per step like a reference
@@ -215,11 +239,20 @@ class AsyncTrainer:
         self.registry = declare_training_metrics(Registry())
         if cfg.elastic:
             declare_elastic_metrics(self.registry)
+        if self._hier:
+            declare_hierarchy_metrics(self.registry)
+        # Resilience counters reach the SCRAPE endpoint, not just the
+        # JSONL: whenever a fault/retry plane is armed, declare the
+        # contract and refresh it from the live snapshots on every render.
+        collect = []
+        if self.injector is not None or self._retrier is not None:
+            declare_resilience_metrics(self.registry)
+            collect.append(self._pump_resilience_metrics)
         self.exporter = None
         if cfg.metrics_port > 0:
             self.exporter = MetricsExporter(
                 self.registry, port=cfg.metrics_port + self.pid,
-                health_fn=self._health_status).start()
+                health_fn=self._health_status, collect=collect).start()
         self.last_publish_s = 0.0
         self.version = 0        # canonical PS step (leader-owned)
         self.applied = 0
@@ -238,8 +271,19 @@ class AsyncTrainer:
                 lambda p, o, g: apply_optimizer(self.tx, p, o, g),
                 out_shardings=(rep, rep))
 
-    def _make_leader_aggregator(self) -> StaleGradientAggregator:
+    def _make_leader_aggregator(self):
         cfg = self.cfg
+        if self._hier:
+            # Root tier pools GROUP aggregates; K-of-N applies per tier,
+            # so the member-count knob is clamped to the group count.
+            from ps_pytorch_tpu.parallel.hierarchy import RootAggregator
+            plan = self.transport.plan
+            return RootAggregator(
+                plan.n_groups, cfg.grad_codec,
+                staleness_limit=cfg.staleness_limit,
+                staleness_decay=cfg.staleness_decay,
+                num_aggregate=min(cfg.num_aggregate, plan.n_groups),
+                on_event=self._hier_event)
         if self._wire_homo:
             # Homomorphic wire: the pool holds PAYLOADS (submit_encoded)
             # and collect() sums them in the compressed domain. EF stays
@@ -254,6 +298,65 @@ class AsyncTrainer:
             staleness_decay=cfg.staleness_decay,
             num_aggregate=cfg.num_aggregate,
             compress=False)  # the WIRE is compressed; the pool is local
+
+    def _pump_resilience_metrics(self) -> None:
+        """Refresh resilience counters from the live fault/retry snapshots
+        (delta-inc: Registry counters are monotonic, snapshots are the
+        source of truth). Runs as a MetricsExporter collect hook, so every
+        scrape sees current values without the train loop's involvement."""
+        snap = {}
+        if self.injector is not None:
+            snap.update(self.injector.snapshot())
+        if self._retrier is not None:
+            snap.update(self._retrier.snapshot())
+        for name, value in snap.items():
+            try:
+                delta = value - self.registry.get(name)
+            except KeyError:
+                continue            # snapshot key with no declared metric
+            if delta > 0:
+                self.registry.inc(name, delta)
+
+    def _hier_telemetry(self) -> dict:
+        """Delta-inc the hierarchy_* registry counters from the live
+        transport/root snapshots; returns the JSONL columns."""
+        st = self.transport.stats
+        pairs = [("hierarchy_group_publishes", st["group_publishes"]),
+                 ("hierarchy_failovers", st["failovers"])]
+        hops = st["hops"]
+        extra = {"hier_group_publishes": st["group_publishes"],
+                 "hier_failovers": st["failovers"],
+                 "hier_hop_giveups": st["hop_giveups"]}
+        self.registry.set("hierarchy_groups",
+                          float(self.transport.plan.n_groups))
+        if self.leader:
+            snap = self.aggregator.snapshot()
+            hops += snap["hops"]
+            self.registry.set("hierarchy_groups_healthy",
+                              float(snap["groups_healthy"]))
+            pairs.append(("hierarchy_degraded_steps",
+                          snap["degraded_steps"]))
+            extra["hier_groups_healthy"] = snap["groups_healthy"]
+            extra["hier_degraded_steps"] = snap["degraded_steps"]
+        pairs.append(("hierarchy_hops", hops))
+        for name, value in pairs:
+            delta = value - self.registry.get(name)
+            if delta > 0:
+                self.registry.inc(name, delta)
+        return extra
+
+    def _hier_event(self, kind: str, gid: int, step: int,
+                    staleness: int) -> None:
+        """Root-tier lifecycle callback: one parseable line per subtree
+        transition (tools/hierarchy_drill.py greps these) + counters."""
+        if kind == "partition":
+            self.registry.inc("hierarchy_partitions")
+            print(f"HIER partition group {gid} at version {step} "
+                  f"(silent {staleness})", flush=True)
+        elif kind == "regraft":
+            self.registry.inc("hierarchy_regrafts")
+            print(f"HIER regraft group {gid} at version {step} "
+                  f"staleness {staleness}", flush=True)
 
     def _health_status(self) -> dict:
         body = {"ok": True, "process_index": self.pid,
@@ -486,14 +589,21 @@ class AsyncTrainer:
     def _leader_apply(self) -> int:
         """Pool new wire contributions and apply at most one update.
         Returns number of contributions used."""
-        for s, step, wire in self.transport.poll_new_grads():
-            if self._wire_homo:
-                # Payloads enter the pool AS PAYLOADS: no per-contributor
-                # float32 is ever materialized leader-side; decode happens
-                # once, after the K-of-N cutoff inside collect().
-                self.aggregator.submit_encoded(s, step, wire)
-            else:
-                self.aggregator.submit(s, step, self._decode_grads(wire))
+        if self._hier:
+            # Root tier: the wire carries GROUP aggregates, one payload
+            # tree per group with (step, wsum) meta — pool them as groups.
+            for gid, step, wsum, tree in self.transport.poll_new_aggs():
+                self.aggregator.submit_group(gid, step, wsum, tree)
+        else:
+            for s, step, wire in self.transport.poll_new_grads():
+                if self._wire_homo:
+                    # Payloads enter the pool AS PAYLOADS: no
+                    # per-contributor float32 is ever materialized
+                    # leader-side; decode happens once, after the K-of-N
+                    # cutoff inside collect().
+                    self.aggregator.submit_encoded(s, step, wire)
+                else:
+                    self.aggregator.submit(s, step, self._decode_grads(wire))
         avg, pool = self.aggregator.collect(self.version)
         used = 0
         if avg is not None and pool["used"]:
@@ -554,6 +664,23 @@ class AsyncTrainer:
                       f"world {msnap['world_size']} membership_changes "
                       f"{msnap['membership_changes']} wins "
                       f"{self.election.stats['wins']}", flush=True)
+            if self._hier:
+                # One parseable hierarchy summary per process — the chaos
+                # drill (tools/hierarchy_drill.py) reads its partition/
+                # regraft/degraded evidence from here.
+                st = self.transport.stats
+                line = (f"HIERARCHY pid {self.pid} gid {self.transport.gid} "
+                        f"aggregator {int(self.transport.is_aggregator)} "
+                        f"hops {st['hops']} publishes "
+                        f"{st['group_publishes']} failovers "
+                        f"{st['failovers']} giveups {st['hop_giveups']}")
+                if self.leader:
+                    root = self.aggregator.snapshot()
+                    line += (f" partitions {root['partitions']} regrafts "
+                             f"{root['regrafts']} degraded_steps "
+                             f"{root['degraded_steps']} groups_healthy "
+                             f"{root['groups_healthy']}")
+                print(line, flush=True)
         finally:
             if self.announcer is not None:
                 try:
@@ -606,6 +733,17 @@ class AsyncTrainer:
                     self.params = jax.device_put(tree["params"], self._rep)
             m = self._compute_and_submit(my_version)
             own_steps += 1
+            if self._hier:
+                # Every process pumps: the group lease stays fresh, and
+                # whoever holds it drains member channels and publishes
+                # the re-encoded aggregate upward (after the submit above,
+                # so an aggregator pools its OWN contribution same-round).
+                before = self.transport.stats["failovers"]
+                self.transport.pump(my_version)
+                if self.transport.stats["failovers"] > before:
+                    print(f"HIER failover: process {self.pid} adopted "
+                          f"aggregator role for group {self.transport.gid} "
+                          f"at own step {own_steps}", flush=True)
             used = self._leader_apply() if self.leader else 0
             step_for_log = self.version if self.leader else own_steps
             self.registry.inc("train_steps")
@@ -634,6 +772,8 @@ class AsyncTrainer:
                     if delta > 0:
                         self.registry.inc("membership_changes", delta)
                     extra["leader_epoch"] = self.election.epoch
+                if self._hier:
+                    extra.update(self._hier_telemetry())
                 if self.injector is not None:
                     extra.update(self.injector.snapshot())
                 if self._retrier is not None:

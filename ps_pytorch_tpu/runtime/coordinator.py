@@ -29,7 +29,7 @@ consumes the mask vector):
 import json
 import threading
 import time
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 import numpy as np
 
@@ -66,6 +66,12 @@ class KVStore:
     def delete(self, key: str) -> None:
         with self._lock:
             self._d.pop(key, None)
+
+    def keys(self, prefix: str = "") -> List[str]:
+        """Keys under ``prefix`` (in-process store only — the distributed
+        backend has no scan; tests and in-process drills use this)."""
+        with self._lock:
+            return sorted(k for k in self._d if k.startswith(prefix))
 
 
 class DistributedKV(KVStore):

@@ -45,7 +45,8 @@ from ps_pytorch_tpu.runtime import checkpoint as ckpt
 from ps_pytorch_tpu.runtime.metrics import MetricsLogger
 from ps_pytorch_tpu.telemetry import (
     FlightRecorder, HealthMonitor, MetricsExporter, Registry, Tracer,
-    aggregate_peak_flops, declare_training_metrics, derive_step_record,
+    aggregate_peak_flops, declare_resilience_metrics,
+    declare_training_metrics, derive_step_record,
     device_memory_record, host_rss_bytes, set_default_tracer, step_flops_of,
 )
 
@@ -213,10 +214,29 @@ class LMTrainer:
                                             registry=self.registry)
         self.exporter: Optional[MetricsExporter] = None
         if cfg.metrics_port > 0:
+            collect = []
+            if self.injector is not None:
+                declare_resilience_metrics(self.registry)
+                collect.append(self._pump_resilience_metrics)
             self.exporter = MetricsExporter(
                 self.registry,
                 port=cfg.metrics_port + jax.process_index(),
-                health_fn=self._health_status).start()
+                health_fn=self._health_status,
+                collect=collect).start()
+
+    def _pump_resilience_metrics(self) -> None:
+        """Refresh resilience counters from the live fault-injector snapshot
+        (delta-inc: Registry counters are monotonic, the snapshot is the
+        source of truth). Runs as a MetricsExporter collect hook."""
+        if self.injector is None:
+            return
+        for name, value in self.injector.snapshot().items():
+            try:
+                delta = value - self.registry.get(name)
+            except KeyError:
+                continue            # snapshot key with no declared metric
+            if delta > 0:
+                self.registry.inc(name, delta)
 
     def _health_status(self) -> dict:
         body = self.health.status() if self.health is not None else {"ok": True}

@@ -110,14 +110,30 @@ class MultiSliceTrainer:
             lambda a: jnp.tile(a[None], (per,) + (1,) * a.ndim), bs0)
             for _ in range(n_slices)]
 
-        self.aggregator = StaleGradientAggregator(
-            n_slices, staleness_limit=cfg.staleness_limit,
-            staleness_decay=cfg.staleness_decay,
-            num_aggregate=cfg.num_aggregate, compress=cfg.compress_grad,
-            codec=cfg.grad_codec, codec_level=cfg.codec_level,
-            wire_bucket_bytes=int(cfg.wire_bucket_mb * (1 << 20)),
-            wire_workers=cfg.wire_workers,
-            topk_frac=cfg.grad_topk_frac, error_feedback=cfg.ef)
+        if cfg.sync_topology == "hier":
+            # 2-tier multi-hop aggregation (parallel/hierarchy.py) behind
+            # the same duck-typed surface: submit/collect/consume/GC/EF all
+            # keep their meaning, so tick() below is topology-blind.
+            from ps_pytorch_tpu.parallel.hierarchy import (
+                HierarchicalAggregator,
+            )
+            self.aggregator = HierarchicalAggregator(
+                n_slices, group_size=cfg.sync_group_size,
+                staleness_limit=cfg.staleness_limit,
+                staleness_decay=cfg.staleness_decay,
+                num_aggregate=cfg.num_aggregate, codec=cfg.grad_codec,
+                topk_frac=cfg.grad_topk_frac, error_feedback=cfg.ef,
+                intra_every=cfg.sync_intra_every,
+                inter_every=cfg.sync_inter_every)
+        else:
+            self.aggregator = StaleGradientAggregator(
+                n_slices, staleness_limit=cfg.staleness_limit,
+                staleness_decay=cfg.staleness_decay,
+                num_aggregate=cfg.num_aggregate, compress=cfg.compress_grad,
+                codec=cfg.grad_codec, codec_level=cfg.codec_level,
+                wire_bucket_bytes=int(cfg.wire_bucket_mb * (1 << 20)),
+                wire_workers=cfg.wire_workers,
+                topk_frac=cfg.grad_topk_frac, error_feedback=cfg.ef)
         from ps_pytorch_tpu.data.augment import input_norm_for
         self._input_norm = input_norm_for(cfg)
         self.grad_fns = [make_slice_grad_fn(self.model, m, self.has_bn,
@@ -236,7 +252,7 @@ class MultiSliceTrainer:
         # run re-sends error the accumulator had already banked, so the
         # checkpoint carries them as extra state whenever EF is on.
         extra = {"ef": self.aggregator.ef_state_dict()} \
-            if self.cfg.ef else None
+            if (self.cfg.ef or self.cfg.sync_topology == "hier") else None
         ckpt.save_checkpoint(self.cfg.train_dir, self.step,
                              jax.device_get(self._as_train_state()),
                              config_json=self.cfg.to_json(),

@@ -39,8 +39,9 @@ from ps_pytorch_tpu.runtime.metrics import MetricsLogger
 from ps_pytorch_tpu.telemetry import (
     FlightRecorder, HealthMonitor, MetricsExporter, Registry,
     TelemetryAggregator, Tracer, aggregate_peak_flops,
-    declare_training_metrics, derive_step_record, device_memory_record,
-    host_rss_bytes, set_default_tracer, step_flops_of,
+    declare_resilience_metrics, declare_training_metrics,
+    derive_step_record, device_memory_record, host_rss_bytes,
+    set_default_tracer, step_flops_of,
 )
 
 from ps_pytorch_tpu.data.datasets import sample_shape
@@ -214,11 +215,18 @@ class Trainer:
         # a scraper sees every host of a multi-process run.
         self.exporter: Optional[MetricsExporter] = None
         if cfg.metrics_port > 0:
+            collect = [self._update_memory_gauges]
+            if self.injector is not None or self._retrier is not None:
+                # Resilience counters reach the SCRAPE endpoint, not just
+                # the JSONL: refresh them from the live fault/retry
+                # snapshots on every render.
+                declare_resilience_metrics(self.registry)
+                collect.append(self._pump_resilience_metrics)
             self.exporter = MetricsExporter(
                 self.registry,
                 port=cfg.metrics_port + jax.process_index(),
                 health_fn=self._health_status,
-                collect=[self._update_memory_gauges]).start()
+                collect=collect).start()
         # MFU inputs: per-step FLOPs are traced lazily at step 1 (the step
         # must exist first); the chips' peak is a device_kind lookup (None
         # off-TPU -> mfu reported as null, never a fiction).
@@ -348,6 +356,23 @@ class Trainer:
             self.registry.set("device_mem_bytes",
                               mem.get("device_mem_bytes", 0))
         self.registry.set("host_rss_bytes", host_rss_bytes())
+
+    def _pump_resilience_metrics(self) -> None:
+        """Refresh resilience counters from the live fault/retry snapshots
+        (delta-inc: Registry counters are monotonic, the snapshots are the
+        source of truth). Runs as a MetricsExporter collect hook."""
+        snap = {}
+        if self.injector is not None:
+            snap.update(self.injector.snapshot())
+        if self._retrier is not None:
+            snap.update(self._retrier.snapshot())
+        for name, value in snap.items():
+            try:
+                delta = value - self.registry.get(name)
+            except KeyError:
+                continue            # snapshot key with no declared metric
+            if delta > 0:
+                self.registry.inc(name, delta)
 
     def _health_status(self) -> dict:
         """/healthz body: watchdog state (stall evaluated on demand from the

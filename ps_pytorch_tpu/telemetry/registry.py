@@ -200,6 +200,10 @@ RESILIENCE_COUNTERS = (
     ("evictions", "events", "replicas evicted for missed heartbeats"),
     ("readmissions", "events", "evicted replicas readmitted on recovery"),
     ("mask_changes", "events", "leader participation-mask changes"),
+    ("leader_kills", "events", "injected leader SIGKILLs fired"),
+    ("kv_partition_drops", "ops",
+     "KV ops dropped inside an injected partition window"),
+    ("link_jitters", "ops", "injected per-link KV delays applied"),
 )
 
 
@@ -207,6 +211,40 @@ def declare_resilience_metrics(registry: Registry) -> Registry:
     """Declare every resilience counter on ``registry`` (all monotonic)."""
     for name, unit, help_ in RESILIENCE_COUNTERS:
         registry.counter(name, unit=unit, help=help_)
+    return registry
+
+
+# ---- hierarchical sync contract (ps_pytorch_tpu/parallel/hierarchy.py) ----
+#
+# The 2-tier aggregation plane's reviewable surface: per-hop traffic,
+# subtree partition/regraft lifecycle, aggregator failovers, and the live
+# group-health gauges a dashboard needs to see a degraded run AT A GLANCE.
+HIERARCHY_COUNTERS = (
+    ("hierarchy_hops", "ops", "aggregation hops completed (any tier)"),
+    ("hierarchy_group_publishes", "ops",
+     "group aggregates re-encoded and published upward"),
+    ("hierarchy_partitions", "events",
+     "subtrees declared partitioned (went stale past the limit)"),
+    ("hierarchy_regrafts", "events",
+     "partitioned subtrees re-grafted after healing"),
+    ("hierarchy_degraded_steps", "steps",
+     "root updates applied with at least one subtree missing"),
+    ("hierarchy_failovers", "events",
+     "group aggregator roles adopted by another member"),
+)
+HIERARCHY_GAUGES = (
+    ("hierarchy_groups", "groups", "sync groups in the topology"),
+    ("hierarchy_groups_healthy", "groups",
+     "groups contributing within the staleness limit"),
+)
+
+
+def declare_hierarchy_metrics(registry: Registry) -> Registry:
+    """Declare the hierarchical-sync counters/gauges on ``registry``."""
+    for name, unit, help_ in HIERARCHY_COUNTERS:
+        registry.counter(name, unit=unit, help=help_)
+    for name, unit, help_ in HIERARCHY_GAUGES:
+        registry.gauge(name, unit=unit, help=help_)
     return registry
 
 

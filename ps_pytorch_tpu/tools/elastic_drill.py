@@ -81,6 +81,8 @@ def _worker_failover(args) -> None:
         lr=0.05, momentum=0.9, compute_dtype="float32", mode="async",
         max_steps=args.max_steps, eval_freq=4, train_dir=args.train_dir,
         resume=False, log_every=2,
+        compress_grad=bool(args.grad_codec), grad_codec=args.grad_codec,
+        ef=args.ef,
         elastic=True, elastic_leader=1, leader_lease_s=3.0,
         heartbeat_interval_s=3.0, kv_retry_attempts=3,
         fault_spec=f"leader_kill:step={args.kill_step}" if armed else "")
@@ -222,6 +224,14 @@ def main(argv=None) -> int:
     # leaving the election nothing to lead (it would land at the finish
     # line with membership never folded).
     ap.add_argument("--kill-step", type=int, default=2)
+    # Gradient-compression soak: run the failover phase with a compressed
+    # wire codec so the kill/election path also exercises the error-
+    # feedback residual surviving leader promotion.
+    ap.add_argument("--grad-codec", default="",
+                    help="wire codec for the failover phase "
+                         "(e.g. int8lat); empty = uncompressed")
+    ap.add_argument("--ef", action="store_true",
+                    help="enable error feedback with --grad-codec")
     ap.add_argument("--out", default="RESILIENCE_r11.json")
     ap.add_argument("--run-dir", default="/tmp/elastic_drill")
     args = ap.parse_args(argv)
@@ -246,7 +256,9 @@ def main(argv=None) -> int:
     rc1 = _launch(d1, _free_port(), [
         "--phase", "failover", "--train-dir", str(d1 / "ckpt"),
         "--max-steps", str(args.max_steps),
-        "--kill-step", str(args.kill_step)])
+        "--kill-step", str(args.kill_step)]
+        + (["--grad-codec", args.grad_codec] if args.grad_codec else [])
+        + (["--ef"] if args.ef else []))
     logs = _logs(d1)
     dump = "\n\n".join(f"== proc_{i} ==\n{t[-2500:]}"
                        for i, t in enumerate(logs))
@@ -304,6 +316,8 @@ def main(argv=None) -> int:
         "processes": 3,
         "ok": ok,
         "bitwise_equal": bitwise,
+        "grad_codec": args.grad_codec or "none",
+        "error_feedback": bool(args.ef),
         "counters": {"leader_kills": int(killed), "kv_giveups": 0},
         "elastic": {
             "elections": len(elected),

@@ -28,6 +28,14 @@ Families and their watched metrics (direction, relative tolerance):
                                         section: >=1 election, >=1
                                         membership change, final epoch >=2,
                                         ok true, kv_giveups 0
+- ``hierarchy``  RESILIENCE_r*.json     newest artifact WITH a "hierarchy"
+                                        section: the chaos drill saw >=1
+                                        partition, >=1 regraft and >=1
+                                        degraded step, ok/bitwise_equal
+                                        true, and the hier-vs-flat bench
+                                        recorded a speedup > 1 (kv_giveups
+                                        are EXPECTED — a partition makes
+                                        the retry plane give up by design)
 
 Rows are matched by their "config" name — a config present in the baseline
 but missing from the candidate is a failure (silently dropping a bench row
@@ -114,6 +122,22 @@ FAMILIES: Dict[str, dict] = {
         "min_elastic": [("elections", 1), ("membership_changes", 1),
                         ("final_epoch", 2)],
     },
+    "hierarchy": {
+        # Same artifact series again, gating the hierarchical-sync chaos
+        # drill (tools/hierarchy_drill.py): the newest RESILIENCE_r*.json
+        # carrying a "hierarchy" section must show the full partition ->
+        # degrade -> heal -> re-graft arc actually happened, the resumed
+        # continuation stayed bitwise-reproducible, and the tiered
+        # topology still beats the flat star on the recorded bench.
+        # kv_giveups is deliberately NOT zero-gated here: giving up after
+        # bounded retries inside a partition window IS the degraded-mode
+        # contract.
+        "pattern": "RESILIENCE_r[0-9]*.json",
+        "metrics": [],              # invariant check, see _check_hierarchy
+        "bools": ["bitwise_equal", "ok"],
+        "min_hierarchy": [("partitions", 1), ("regrafts", 1),
+                          ("degraded_steps", 1)],
+    },
 }
 
 
@@ -173,6 +197,8 @@ def compare(family: str, baseline, candidate) -> dict:
         return _check_resilience(spec, candidate)
     if family == "elastic":
         return _check_elastic(spec, candidate)
+    if family == "hierarchy":
+        return _check_hierarchy(spec, candidate)
     if family == "ops":
         return _check_ops(spec, candidate)
     if family == "slo":
@@ -347,6 +373,35 @@ def _check_elastic(spec: dict, candidate) -> dict:
             "configs": {"invariants": {"ok": ok, "metrics": checks}}}
 
 
+def _check_hierarchy(spec: dict, candidate) -> dict:
+    doc = candidate if isinstance(candidate, dict) else \
+        (candidate[0] if candidate else {})
+    checks: Dict[str, dict] = {}
+    ok = True
+    hier = doc.get("hierarchy")
+    if not isinstance(hier, dict):
+        return {"family": "hierarchy", "ok": False,
+                "configs": {"invariants": {"ok": False, "metrics": {
+                    "_hierarchy": {"ok": False,
+                                   "note": "artifact has no hierarchy "
+                                           "section"}}}}}
+    for key in spec["bools"]:
+        if key in doc:
+            checks[key] = {"cand": doc[key], "ok": bool(doc[key])}
+            ok = ok and checks[key]["ok"]
+    for key, floor in spec["min_hierarchy"]:
+        val = int(hier.get(key, 0))
+        checks[key] = {"cand": val, "floor": floor, "ok": val >= floor}
+        ok = ok and checks[key]["ok"]
+    bench = hier.get("bench", {})
+    speedup = float(bench.get("speedup", 0.0))
+    checks["bench_speedup"] = {"cand": speedup, "floor": 1.0,
+                               "ok": speedup > 1.0}
+    ok = ok and checks["bench_speedup"]["ok"]
+    return {"family": "hierarchy", "ok": ok,
+            "configs": {"invariants": {"ok": ok, "metrics": checks}}}
+
+
 def run_gate(family: str, candidate_path: str, repo: str = ".",
              baseline_path: str = "") -> dict:
     """Gate one candidate artifact against the newest committed baseline
@@ -355,7 +410,8 @@ def run_gate(family: str, candidate_path: str, repo: str = ".",
     against its predecessor."""
     candidate = load_artifact(candidate_path)
     baseline = None
-    if family not in ("resilience", "ops", "slo", "wire_codec"):
+    if family not in ("resilience", "ops", "slo", "wire_codec",
+                      "hierarchy"):
         if baseline_path:
             baseline = load_artifact(baseline_path)
         else:
@@ -386,14 +442,14 @@ def run_all(repo: str = ".") -> dict:
             families[family] = {"family": family, "ok": True,
                                 "note": "no committed artifacts; skipped"}
             continue
-        if family == "elastic":
-            # Gate the newest artifact that actually ran the elastic drill
+        if family in ("elastic", "hierarchy"):
+            # Gate the newest artifact that actually ran this drill
             # (older RESILIENCE rounds predate the subsystem).
             with_section = [p for p in paths if isinstance(
-                load_artifact(p), dict) and "elastic" in load_artifact(p)]
+                load_artifact(p), dict) and family in load_artifact(p)]
             if not with_section:
                 families[family] = {"family": family, "ok": True,
-                                    "note": "no artifact with an elastic "
+                                    "note": f"no artifact with a {family} "
                                             "section; skipped"}
                 continue
             families[family] = run_gate(family, with_section[-1], repo=repo)

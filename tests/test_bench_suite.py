@@ -106,3 +106,40 @@ def test_codec_agg_bench_rows(tmp_path):
     assert len(s["buckets"]) >= 2
     assert s["total_bytes_raw"] > 0 and s["total_ratio"] is not None
     assert s["publish"]["bytes"] == sum(b["bytes"] for b in s["buckets"])
+
+
+def test_latency_kv_prefix_classes(monkeypatch):
+    """Per-key-prefix latency classes: first matching prefix wins, the flat
+    RTT is the fallback, and every op is counted — the 2-tier DCN model the
+    hierarchy bench leans on."""
+    import bench_suite
+    from bench_suite import LatencyKV
+    from ps_pytorch_tpu.runtime.coordinator import KVStore
+
+    waits = []
+    monkeypatch.setattr(bench_suite.time, "sleep", waits.append)
+    kv = LatencyKV(KVStore(), 0.030,
+                   classes=[("b/hgrad/", 0.001), ("b/hagg/", 0.005)])
+    kv.set("b/hgrad/0/1", "fast-intra-link")
+    assert kv.get("b/aparams") is None          # no prefix match -> flat RTT
+    kv.set("b/hagg/0", "uplink")
+    kv.delete("b/hgrad/0/1")
+    assert waits == [0.001, 0.030, 0.005, 0.001]
+    assert kv.ops == 4
+    assert kv.get("b/hagg/0") == "uplink"       # ops still hit the inner KV
+
+
+def test_hier_agg_bench_row():
+    """Tiny flat-vs-hierarchy row: at a 20x inter/intra latency split the
+    2-tier tree must beat the flat star, ship fewer slow-link ops, and hold
+    the re-encode error to codec-lattice scale."""
+    from bench_suite import bench_hier_agg
+
+    r = bench_hier_agg("ht", 1, codec="int8lat", payload_mb=1, leaf_kb=256,
+                       n_slices=4, group_size=2, intra_rtt_ms=0.5,
+                       inter_rtt_ms=10.0)
+    assert r["n_groups"] == 2 and r["n_slices"] == 4
+    assert r["flat_s"] > 0 and r["hier_s"] > 0
+    assert r["speedup"] > 1.0
+    assert r["hier_kv_ops"] != r["flat_kv_ops"]
+    assert r["rel_err"] < 0.02                  # <= one int8 lattice step

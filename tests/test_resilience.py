@@ -722,3 +722,99 @@ def test_dir_get_oversized_fallback_reraises_other_errors():
     kv._has_try_get = False
     with pytest.raises(RuntimeError, match="UNAVAILABLE"):
         kv.get("run/adone")
+
+
+# ---- resilience counters on the scrape endpoint ----
+
+def test_trainer_metrics_exposes_resilience_counters(tmp_path):
+    """Injector/retry counters reach the Prometheus /metrics exposition
+    (not just the JSONL) through the exporter's collect hook."""
+    import urllib.request
+
+    from conftest import free_port
+    from ps_pytorch_tpu.telemetry import parse_exposition
+
+    cfg = _tiny_cfg(tmp_path, fault_spec="kv_drop:p=0.25,seed=11",
+                    kv_retry_attempts=6, metrics_port=free_port(),
+                    eval_freq=0, max_steps=4)
+    t = Trainer(cfg)
+    try:
+        for i in range(40):      # through the fault + retry shims
+            try:
+                t.coordinator.kv.set(f"probe/{i}", "x")
+            except TransientKVError:
+                pass             # a giveup past the retry budget is fine
+        url = f"http://127.0.0.1:{t.exporter.port}/metrics"
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            samples = parse_exposition(resp.read().decode())
+        assert samples["kv_drops_total"] > 0
+        assert samples["kv_retries_total"] > 0
+        assert "kv_giveups_total" in samples
+        assert "kv_partition_drops_total" in samples
+        assert "link_jitters_total" in samples
+    finally:
+        t.exporter.stop()
+
+
+# ---- leader_kill x compressed wire (PR 7 x PR 9 interaction) ----
+
+def test_async_ef_residual_survives_resume_bitwise(tmp_path):
+    """The async leader's error-feedback residual rides the checkpoint as
+    extra state and reloads BIT-FOR-BIT, so an auto-resumed run re-encodes
+    exactly what the uninterrupted one would have."""
+    from ps_pytorch_tpu.runtime.async_trainer import AsyncTrainer
+
+    cfg = TrainConfig(dataset="synthetic_mnist", network="LeNet",
+                      batch_size=64, lr=0.05, momentum=0.9,
+                      compute_dtype="float32", mode="async", max_steps=8,
+                      eval_freq=4, train_dir=str(tmp_path / "ckpt"),
+                      resume=False, log_every=100, compress_grad=True,
+                      grad_codec="int8lat", ef=True)
+    t = AsyncTrainer(cfg)
+    t.train()
+    assert t._ef is not None and t._ef.residual_nbytes() > 0
+    step = ckpt.latest_valid_step(cfg.train_dir)
+    saved = ckpt.load_extra_state(cfg.train_dir, step)["ef"]
+    t2 = AsyncTrainer(cfg.replace(resume=True))
+    assert t2._maybe_resume()
+    restored = t2._ef.state_dict()
+    assert set(restored) == set(saved) and restored
+    for k in saved:
+        np.testing.assert_array_equal(np.asarray(saved[k]),
+                                      np.asarray(restored[k]))
+
+
+@pytest.mark.slow
+def test_leader_kill_int8lat_ef_chaos_soak(tmp_path):
+    """Chaos soak combining leader_kill with the compressed homomorphic
+    wire: the drill's failover phase under --grad-codec int8lat --ef. The
+    kill fires, a follower promotes (its own sender-side EF residual is
+    untouched by _promote), survivors finish, and the promoted leader's
+    checkpoint carries a reloadable nonzero EF residual."""
+    import re
+
+    from ps_pytorch_tpu.compression.codecs import ErrorFeedback
+    from ps_pytorch_tpu.tools import elastic_drill as ed
+
+    run_dir = tmp_path / "failover"
+    rc = ed._launch(run_dir, ed._free_port(), [
+        "--phase", "failover", "--train-dir", str(run_dir / "ckpt"),
+        "--max-steps", "40", "--kill-step", "2",
+        "--grad-codec", "int8lat", "--ef"])
+    logs = ed._logs(run_dir)
+    dump = "\n\n".join(f"== proc_{i} ==\n{t[-3000:]}"
+                       for i, t in enumerate(logs))
+    assert rc != 2, dump
+    assert "FAULT leader_kill: SIGKILL" in logs[1], dump
+    elected = re.findall(r"ELECTED async leader process (\d+)",
+                         "\n".join(logs))
+    assert len(elected) == 1 and elected[0] in ("0", "2"), dump
+    finals = [i for i, t in enumerate(logs) if i != 1 and "FINAL" in t]
+    assert finals == [0, 2], dump
+    step = ckpt.latest_valid_step(str(run_dir / "ckpt"))
+    assert step is not None, dump
+    extra = ckpt.load_extra_state(str(run_dir / "ckpt"), step)
+    assert extra and extra.get("ef"), dump
+    ef = ErrorFeedback()
+    ef.load_state_dict(extra["ef"])
+    assert ef.residual_nbytes() > 0
