@@ -389,9 +389,18 @@ class HierarchicalAggregator:
     so ``--sync-topology hier`` swaps into MultiSliceTrainer untouched.
 
     submit() runs the member-side encode (with per-member EF when asked)
-    into the member's group pool; collect() runs every due group hop, then
-    the root hop, and reports the MEMBER ids it consumed so the trainer's
-    existing consume/GC calls keep their meaning.
+    into the member pool; collect() routes pooled payloads to their group
+    pools every ``intra_every`` rounds (latest-wins, like every other pool
+    tier) and runs the group hops only on ``inter_every`` rounds — a hop's
+    output always goes up, so no computed aggregate is ever discarded
+    short of the root. ``info["used"]`` reports the MEMBER ids whose
+    contribution reached the root average actually returned (non-empty
+    exactly when the average is non-None), so the trainer's apply gate and
+    consume/GC calls keep their meaning.
+
+    ``num_aggregate`` counts GROUPS at the root (K-of-N per tier); a
+    member-count value from a flat-topology config is clamped to the
+    plan's group count, same as the async trainer's root setup.
     """
 
     def __init__(self, n_slices: int, group_size: int = 0,
@@ -421,8 +430,13 @@ class HierarchicalAggregator:
                         for g in range(self.plan.n_groups)]
         self.root = RootAggregator(
             self.plan.n_groups, codec, staleness_limit=staleness_limit,
-            staleness_decay=staleness_decay, num_aggregate=num_aggregate,
+            staleness_decay=staleness_decay,
+            num_aggregate=min(int(num_aggregate), self.plan.n_groups),
             on_event=on_event)
+        # gid -> member ids that fed the group's pending root aggregate;
+        # replaced on re-submit (latest-wins with the aggregate itself),
+        # popped when the root consumes it.
+        self._group_members: Dict[int, List[int]] = {}
         self._rounds = 0
 
     # ---- StaleGradientAggregator surface ----
@@ -434,44 +448,59 @@ class HierarchicalAggregator:
 
     def collect(self, current_step: int) -> Tuple[Optional[Any], dict]:
         self._rounds += 1
-        used_members: List[int] = []
         if self._rounds % self.intra_every == 0:
-            # Tier 1: route pooled member payloads to their group pools
-            # and run each group's hop.
+            # Tier 1 routing: move pooled member payloads into their group
+            # pools (latest-wins, same discipline as the member pool).
             pend = self._members.pending()
             for sid, step in pend.items():
                 gid = self.plan.group_of(sid)
                 _, leaves, treedef = self._members._pool[sid]
                 self._groups[gid].inner._pool[sid] = (step, leaves, treedef)
             self._members.consume(pend.keys())
+        if self._rounds % self.inter_every == 0:
+            # Group hops run ONLY when the up-link is due: a hop consumes
+            # its members' pooled payloads, so its aggregate must always
+            # travel upward; between inter rounds payloads simply stay
+            # pooled (latest-wins).
             for g in self._groups:
                 before = set(g.pending())
                 out = g.collect_and_reencode(current_step)
                 if out is None:
                     continue
-                used_members.extend(s for s in before
-                                    if s not in g.pending())
                 step, wsum, tree = out
-                if self._rounds % self.inter_every == 0:
-                    self.root.submit_group(g.gid, step, wsum, tree)
+                self.root.submit_group(g.gid, step, wsum, tree)
+                self._group_members[g.gid] = sorted(
+                    s for s in before if s not in g.pending())
         avg, info = self.root.collect(current_step)
         info = dict(info)
         info["used_groups"] = info["used"]
-        info["used"] = sorted(used_members)
+        # Report the members whose contribution is IN the returned average
+        # (covers K-of-N leftovers applied on a later round): non-empty
+        # exactly when avg is non-None, so the trainer's apply gate never
+        # skips an average whose aggregates were consumed below.
+        info["used"] = sorted({m for gid in info["used_groups"]
+                               for m in self._group_members.get(gid, ())})
         if avg is not None:
             self.root.consume(info["used_groups"])
+            for gid in info["used_groups"]:
+                self._group_members.pop(gid, None)
         return avg, info
 
     def consume(self, slice_ids) -> None:
-        # Group/root tiers consume internally in collect(); the trainer's
-        # consume of member ids only needs to clear any re-pooled leftovers.
-        self._members.consume(slice_ids)
+        # Every tier consumes internally in collect(); anything left in the
+        # member pool now is NEWER than what was applied (submitted since
+        # the last routing round), so the trainer's consume of applied
+        # member ids must not clear it.
+        pass
 
     def drop_older_than(self, current_step: int) -> int:
         n = self._members.drop_older_than(current_step)
         for g in self._groups:
             n += g.drop_older_than(current_step)
         n += self.root.drop_older_than(current_step)
+        for gid in list(self._group_members):
+            if gid not in self.root._pool:   # aggregate GC'd: record too
+                del self._group_members[gid]
         return n
 
     def wire_bytes(self) -> int:
@@ -571,6 +600,7 @@ class HierarchicalKVTransport:
         self._sleep = sleep
         self._adopted = False
         self._member_seen: Dict[int, int] = {}
+        self._pub_version = 0       # local monotonic up-link version floor
         self.stats: Dict[str, int] = {
             "hops": 0, "group_publishes": 0, "failovers": 0,
             "hop_giveups": 0}
@@ -690,7 +720,17 @@ class HierarchicalKVTransport:
             return 0
         step, wsum, tree = out
         ch = self._agg_chan(self.gid)
-        version = (ch.latest_version() or 0) + 1
+        # latest_version() returns None both for "nothing published yet"
+        # and for a transient KV read error, so the publish version cannot
+        # be derived from the read alone: one hiccup would reset it to 1,
+        # the root's high-water would then ignore this group until the
+        # counter re-climbed, and publish's GC of version-2 could delete
+        # live keys. A local monotonic floor absorbs that; the observed
+        # version still participates so a failover adopter seeds past its
+        # predecessor as soon as one read succeeds.
+        self._pub_version = max(self._pub_version,
+                                ch.latest_version() or 0) + 1
+        version = self._pub_version
         try:
             call_with_retry(
                 ch.publish, version, tree,

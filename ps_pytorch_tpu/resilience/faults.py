@@ -53,12 +53,15 @@ Spec grammar (``;``-separated faults, each ``kind:key=val,key=val``):
         fires for every process with ``process_index // gsize == group``
         (``gsize`` defaults to 2). The same spec string can be armed on
         every process; it self-scopes to the partitioned subtree.
-    link_jitter:s=0.02[,prefix=hagg][,p=0.5,seed=3][,op=...]
-        Per-LINK delay: matching KV ops whose KEY starts with ``prefix``
-        sleep ``s`` seconds (always, or with probability ``p`` when
-        given). Because hierarchy traffic is key-namespaced per hop
-        (``.../hgrad/<gid>/...`` intra-group, ``.../hagg/<gid>`` up-links),
-        a prefix models one slow link without touching the others — the
+    link_jitter:s=0.02[,prefix=async-0/hagg][,p=0.5,seed=3][,op=...]
+        Per-LINK delay: matching KV ops whose FULL KEY starts with
+        ``prefix`` sleep ``s`` seconds (always, or with probability ``p``
+        when given). Hierarchy traffic is key-namespaced per hop UNDER
+        THE RUN ID (``<run>/hgrad/<gid>/...`` intra-group,
+        ``<run>/hagg/<gid>`` up-links), so the prefix must include it:
+        ``prefix=async-0/hagg`` scopes to run ``async-0``'s up-links,
+        while a bare ``prefix=hagg`` matches no key at all. A scoped
+        prefix models one slow link without touching the others — the
         WAN-edge half of the multi-hop failure model.
 
 Drop/delay decisions come from ``numpy.default_rng(seed + 10007 * pid)``:

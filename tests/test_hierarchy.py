@@ -335,12 +335,80 @@ def test_hier_aggregator_inter_every_amortizes_upward_hops():
                                  inter_every=2)
     agg.submit(0, 1, _grads(1))
     agg.submit(1, 1, _grads(2))
-    avg, _ = agg.collect(1)               # round 1: group hop ran, no uplink
-    assert avg is None
+    avg, _ = agg.collect(1)               # round 1: no uplink due, payloads
+    assert avg is None                    # stay pooled (latest-wins)
     agg.submit(0, 2, _grads(3))
     agg.submit(1, 2, _grads(4))
-    avg, info = agg.collect(2)            # round 2: uplink due
+    avg, info = agg.collect(2)            # round 2: group hop + uplink
     assert avg is not None and info["used_groups"] == [0]
+    assert info["used"] == [0, 1]         # members whose grads reached root
+
+
+def test_hier_inter_every_average_is_latest_wins_not_discarded():
+    """With inter_every=2 the round the up-link skips must leave member
+    payloads pooled: the round-2 average is exactly the flat average of
+    the LATEST submissions, not half of them silently dropped."""
+    hier = HierarchicalAggregator(4, group_size=2, codec="int8lat",
+                                  inter_every=2)
+    flat = StaleGradientAggregator(4, compress=True, codec="int8lat")
+    for sid in range(4):
+        hier.submit(sid, 1, _grads(50 + sid))
+    avg, info = hier.collect(1)
+    assert avg is None and info["used"] == []
+    for sid in (0, 1):                    # slices 2,3 skip round 2: their
+        g = _grads(60 + sid)              # round-1 payloads must survive
+        hier.submit(sid, 2, g)
+        flat.submit(sid, 2, g)
+    for sid in (2, 3):
+        flat.submit(sid, 1, _grads(50 + sid))
+    avg_h, info = hier.collect(2)
+    avg_f, _ = flat.collect(2)
+    assert avg_h is not None and sorted(info["used"]) == [0, 1, 2, 3]
+    for a, b in zip(jax.tree.leaves(avg_h), jax.tree.leaves(avg_f)):
+        a, b = np.asarray(a), np.asarray(b)
+        tol = float(np.max(np.abs(b))) * 2.0 ** -6 + 1e-7
+        assert float(np.max(np.abs(a - b))) <= tol
+
+
+def test_hier_num_aggregate_clamped_to_group_count():
+    # Flat-semantics K (counted in members, e.g. 8 slices K=4) must not
+    # crash the per-tier root, which counts groups: ceil(8/3) = 3.
+    agg = HierarchicalAggregator(8, group_size=3, num_aggregate=4,
+                                 codec="int8lat")
+    assert agg.root.k == agg.plan.n_groups == 3
+
+
+def test_hier_kofn_leftover_average_reports_its_members():
+    """A group aggregate cut by the root's K this round applies on a later
+    one — with its members reported in info['used'], so a trainer gating
+    the update on a non-empty used list never drops a consumed average."""
+    agg = HierarchicalAggregator(2, group_size=1, num_aggregate=1,
+                                 codec="int8lat")
+    agg.submit(0, 1, _grads(1))
+    agg.submit(1, 1, _grads(2))
+    avg, info = agg.collect(1)
+    assert avg is not None and info["used_groups"] == [0]
+    assert info["used"] == [0]
+    avg, info = agg.collect(2)            # leftover group 1 applies now
+    assert avg is not None and info["used_groups"] == [1]
+    assert info["used"] == [1]
+    avg, info = agg.collect(3)
+    assert avg is None and info["used"] == []
+
+
+def test_multislice_hier_accepts_flat_num_aggregate(tmp_path):
+    """8-slice flat config with num_aggregate=4 (valid: K <= n_slices)
+    must construct under sync_topology=hier too, where auto grouping
+    yields 3 groups."""
+    from ps_pytorch_tpu.config import TrainConfig as TC
+    from ps_pytorch_tpu.runtime.multislice import MultiSliceTrainer
+    cfg = TC(dataset="synthetic_mnist", network="LeNet", batch_size=8,
+             compute_dtype="float32", mode="async", max_steps=1,
+             eval_freq=0, train_dir=str(tmp_path / "ckpt"),
+             compress_grad=True, grad_codec="int8lat",
+             sync_topology="hier", num_aggregate=4)
+    t = MultiSliceTrainer(cfg, n_slices=8)
+    assert t.aggregator.root.k == t.aggregator.plan.n_groups == 3
 
 
 # ---- cross-process transport over the KV ----
@@ -415,6 +483,26 @@ def test_transport_partition_window_degrades_not_crashes():
     t0._pool.submit_encoded(0, 7, _encode(_grads(7), 0, 7))
     assert t0.pump(7) == 1
     assert t0.stats["hop_giveups"] == 1
+
+
+def test_pump_publish_version_survives_transient_read_error():
+    """latest_version() returning None (a transient KV hiccup, same shape
+    as 'nothing published') must not reset the up-link version counter:
+    the root's high-water would then ignore the group's publishes."""
+    clock, kv = ManualClock(), KVStore()
+    ts = _transports(kv, clock, n=2, gsz=2)
+    t0 = ts[0]
+    t0.submit_grads(0, 1, 1, _encode(_grads(1), 0, 1))
+    assert t0.pump(1) == 1
+    assert [g for g, _, _, _ in t0.poll_new_aggs()] == [0]
+    ch = t0._agg_chan(0)
+    orig = ch.latest_version
+    ch.latest_version = lambda: None      # the transient-error read shape
+    t0.submit_grads(0, 2, 2, _encode(_grads(2), 0, 2))
+    assert t0.pump(2) == 1
+    ch.latest_version = orig
+    got = t0.poll_new_aggs()              # high-water still sees v2 > v1
+    assert [(g, s) for g, s, _, _ in got] == [(0, 2)]
 
 
 # ---- subtree-scoped fault grammar ----
