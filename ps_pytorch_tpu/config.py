@@ -148,6 +148,11 @@ class TrainConfig:
     slo_spec: str = ""               # serving SLO objectives, e.g. "ttft_p99<100ms;latency_p99<2s;availability>=99.5" (telemetry/slo.py grammar; "" = no SLO tracking)
     reqtrace_keep: int = 256         # request-trace ring capacity; 0 = per-request lifecycle tracing off
     reqtrace_sample: float = 0.05    # fraction of fast `done` requests kept (slow tail + non-done outcomes are always kept)
+    serve_max_body_bytes: int = 1048576  # POST /v1/generate body cap; oversized -> 413 before reading a byte
+    serve_kv_dir: str = ""           # fleet coordination KV directory (FileKV); "" = standalone replica, no fleet registration
+    serve_fleet: str = "fleet"       # fleet name: replicas register at serve/<fleet>/replica/<id> in the KV
+    serve_replica_id: int = 0        # this replica's id in the fleet (also the replica_kill fault's r=)
+    serve_advertise: str = ""        # host the fleet record advertises ("" = serve_host); set when replicas bind 0.0.0.0
 
     # -- logging / profiling / telemetry --
     log_every: int = 1
@@ -265,6 +270,12 @@ class TrainConfig:
         if self.serve_port < 0:
             raise ValueError(f"serve_port={self.serve_port} "
                              "(must be >= 0; 0 = ephemeral)")
+        if self.serve_max_body_bytes < 1:
+            raise ValueError(f"serve_max_body_bytes="
+                             f"{self.serve_max_body_bytes} (must be >= 1)")
+        if self.serve_replica_id < 0:
+            raise ValueError(f"serve_replica_id={self.serve_replica_id} "
+                             "(must be >= 0)")
         if self.slo_spec:
             # Config-time validation, same family as fault_spec/health_spec.
             from ps_pytorch_tpu.telemetry.slo import parse_slo_spec

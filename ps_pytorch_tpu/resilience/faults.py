@@ -53,6 +53,13 @@ Spec grammar (``;``-separated faults, each ``kind:key=val,key=val``):
         fires for every process with ``process_index // gsize == group``
         (``gsize`` defaults to 2). The same spec string can be armed on
         every process; it self-scopes to the partitioned subtree.
+    replica_kill:served=20[,r=0]
+        SIGKILL serving replica ``r`` once it has completed ``served``
+        requests (once) — the serving-plane leader_kill. No drain, no
+        deregistration: the router must detect the death from lease
+        staleness and connection errors and fail the in-flight work over
+        to surviving replicas. The serving loop reports progress via
+        ``maybe_kill_replica``.
     link_jitter:s=0.02[,prefix=async-0/hagg][,p=0.5,seed=3][,op=...]
         Per-LINK delay: matching KV ops whose FULL KEY starts with
         ``prefix`` sleep ``s`` seconds (always, or with probability ``p``
@@ -74,7 +81,7 @@ from typing import Any, Callable, Dict, List, Optional
 import numpy as np
 
 _KINDS = ("kv_drop", "kv_delay", "replica_crash", "ckpt_corrupt", "grad_nan",
-          "leader_kill", "kv_partition", "link_jitter")
+          "leader_kill", "kv_partition", "link_jitter", "replica_kill")
 _KV_OPS = ("set", "get", "delete")
 
 
@@ -176,6 +183,11 @@ def _validate(p: Dict[str, Any], part: str) -> None:
     elif kind == "leader_kill":
         if not isinstance(p.get("step"), int):
             raise ValueError(f"leader_kill needs step=<int> (got {part!r})")
+    elif kind == "replica_kill":
+        if not isinstance(p.get("served"), int):
+            raise ValueError(f"replica_kill needs served=<int> "
+                             f"(got {part!r})")
+        p.setdefault("r", 0)
     elif kind == "kv_partition":
         if not isinstance(p.get("step"), int):
             raise ValueError(f"kv_partition needs step=<int> (got {part!r})")
@@ -325,7 +337,7 @@ class FaultInjector:
         self.counters: Dict[str, int] = {
             "kv_drops": 0, "kv_delays": 0, "crashes": 0,
             "ckpt_corruptions": 0, "grad_nans": 0, "leader_kills": 0,
-            "kv_partition_drops": 0, "link_jitters": 0}
+            "kv_partition_drops": 0, "link_jitters": 0, "replica_kills": 0}
 
     # ---- KV plane ----
     @property
@@ -375,6 +387,27 @@ class FaultInjector:
                 import sys
                 print(f"FAULT leader_kill: SIGKILL process "
                       f"{self.process_index} (leader) at step {step}",
+                      flush=True)
+                sys.stdout.flush()
+                os.kill(os.getpid(), signal.SIGKILL)
+
+    def maybe_kill_replica(self, served: int) -> None:
+        """SIGKILL this serving replica when a replica_kill fault matches
+        this process and it has served >= ``served`` requests (once).
+        The serving-plane analogue of ``maybe_kill_leader``: SIGKILL on
+        purpose — no drain, no deregistration, no final heartbeat — so
+        the router must notice via lease staleness/connection errors,
+        which is exactly what the drill measures."""
+        for i, f in enumerate(self.faults):
+            if f["kind"] != "replica_kill" or ("rkill", i) in self._fired:
+                continue
+            if f["r"] == self.process_index and served >= f["served"]:
+                self._fired.add(("rkill", i))
+                self.counters["replica_kills"] += 1
+                import signal
+                import sys
+                print(f"FAULT replica_kill: SIGKILL replica "
+                      f"{self.process_index} after {served} served",
                       flush=True)
                 sys.stdout.flush()
                 os.kill(os.getpid(), signal.SIGKILL)

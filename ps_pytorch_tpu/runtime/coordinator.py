@@ -148,6 +148,79 @@ class DistributedKV(KVStore):
                 raise
 
 
+class FileKV(KVStore):
+    """KV over a shared directory — the serving fleet's control plane.
+
+    The coordination-service KV needs every process present at
+    ``jax.distributed.initialize`` and cannot survive members dying and
+    rejoining, which is exactly what a serving fleet does (replica
+    SIGKILL, rolling restart). A directory on shared storage has the
+    right lifecycle instead: each key is one file, writes go through a
+    tmp file + ``os.replace`` so readers never see a torn value, and a
+    restarted replica just overwrites its own record. Values are tiny
+    JSON control records (replica registrations, heartbeats), so a
+    listdir-based ``keys()`` scan stays O(fleet size)."""
+
+    def __init__(self, root: str):
+        super().__init__()
+        import os
+        self._root = root
+        os.makedirs(root, exist_ok=True)
+
+    @staticmethod
+    def _fname(key: str) -> str:
+        from urllib.parse import quote
+        return quote(key, safe="")
+
+    def set(self, key: str, value: str) -> None:
+        import os
+        import tempfile
+        path = os.path.join(self._root, self._fname(key))
+        fd, tmp = tempfile.mkstemp(dir=self._root, prefix=".tmp-")
+        try:
+            with os.fdopen(fd, "w") as f:
+                f.write(value)
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+    def get(self, key: str, default: Optional[str] = None) -> Optional[str]:
+        import os
+        path = os.path.join(self._root, self._fname(key))
+        try:
+            with open(path, "r") as f:
+                return f.read()
+        except (FileNotFoundError, OSError):
+            return default
+
+    def delete(self, key: str) -> None:
+        import os
+        try:
+            os.unlink(os.path.join(self._root, self._fname(key)))
+        except OSError:
+            pass
+
+    def keys(self, prefix: str = "") -> List[str]:
+        import os
+        from urllib.parse import unquote
+        try:
+            names = os.listdir(self._root)
+        except OSError:
+            return []
+        out = []
+        for n in names:
+            if n.startswith(".tmp-"):
+                continue
+            k = unquote(n)
+            if k.startswith(prefix):
+                out.append(k)
+        return sorted(out)
+
+
 class Coordinator:
     def __init__(self, n_replicas: int, mode: str = "sync",
                  num_aggregate: int = 0, kill_threshold: float = 0.0,

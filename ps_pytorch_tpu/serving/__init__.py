@@ -19,6 +19,11 @@ The pieces compose bottom-up and each is usable alone:
 - ``reqtrace`` per-request lifecycle traces in a tail-sampled bounded ring
                (``RequestTraceLog``) — the /debug/requests body and the
                Chrome spans `analyze.py stitch` joins to engine spans.
+- ``router``   fleet front-end: replicas self-register in the coordination
+               KV (``FleetRegistrar``), the router health-gates them
+               (``FleetView``: records ∧ lease freshness ∧ /readyz) and
+               load-balances with failover retries, hedged backups, and
+               zero-downtime rolling reload (``Router.roll_reload``).
 
 Entry point: ``serve.py`` at the repo root (flags in ``config.py``:
 ``--serve-slots`` / ``--serve-max-queue`` / ``--serve-reload-s`` /
@@ -31,7 +36,10 @@ from ps_pytorch_tpu.serving.reload import CheckpointWatcher
 from ps_pytorch_tpu.serving.reqtrace import (RequestTrace, RequestTraceLog,
                                              record_terminal,
                                              trace_from_request)
+from ps_pytorch_tpu.serving.router import (Backend, FleetRegistrar,
+                                           FleetView, Router)
 
 __all__ = ["Request", "ServingEngine", "serve_loop", "AdmissionQueue",
            "CheckpointWatcher", "RequestTrace", "RequestTraceLog",
-           "record_terminal", "trace_from_request"]
+           "record_terminal", "trace_from_request", "Backend",
+           "FleetRegistrar", "FleetView", "Router"]

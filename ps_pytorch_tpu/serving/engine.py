@@ -80,14 +80,23 @@ class Request:
     def __post_init__(self):
         self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
         self._event = threading.Event()
+        self._resolve_lock = threading.Lock()
         # per-request sampling chain (engine-owned; mirrors generate()'s
         # carried key exactly)
         self._key = None
 
-    def _resolve(self, state: str, error: str = "") -> None:
-        self.state = state
-        self.error = error
-        self._event.set()
+    def _resolve(self, state: str, error: str = "") -> bool:
+        """Terminal resolution, first-wins. The engine loop, the admission
+        queue's shedder, and an HTTP wait-timeout can all race to resolve
+        the same request; only the first caller may record the terminal
+        reqtrace/SLO sample, so losers get False and must not record."""
+        with self._resolve_lock:
+            if self._event.is_set():
+                return False
+            self.state = state
+            self.error = error
+            self._event.set()
+            return True
 
     def wait(self, timeout: Optional[float] = None) -> bool:
         """Block until the request resolves (done/shed/rejected/failed)."""
@@ -218,10 +227,20 @@ class ServingEngine:
             self.registry.inc("serve_tokens")
         return tok
 
+    def _lost_race(self) -> None:
+        """Count a terminal resolution that lost the first-wins CAS."""
+        if self.registry is not None:
+            try:
+                self.registry.inc("serve_resolve_races")
+            except KeyError:
+                pass   # registry predates the race counter
+
     def _complete(self, req: Request) -> None:
         req.t_done = self.clock()
+        if not req._resolve("done"):
+            self._lost_race()
+            return
         self.served += 1
-        req._resolve("done")
         if self.registry is not None:
             self.registry.inc("serve_requests")
             if req.t_submit:
@@ -235,7 +254,9 @@ class ServingEngine:
 
     def _fail(self, req: Request, error: str) -> None:
         """Resolve an unadmittable request as failed and record it."""
-        req._resolve("failed", error)
+        if not req._resolve("failed", error):
+            self._lost_race()
+            return
         record_terminal(req, reqtrace=self.reqtrace, slo=self.slo,
                         now=self.clock())
 
@@ -381,7 +402,7 @@ def serve_loop(engine: ServingEngine, queue, *, watcher=None,
                reload_s: float = 10.0, stop: Optional[threading.Event] = None,
                idle_wait_s: float = 0.02,
                clock: Callable[[], float] = time.monotonic,
-               health=None) -> None:
+               health=None, injector=None, registrar=None) -> None:
     """The serving drive loop (one thread): admit from the queue while slots
     are free, tick the engine while anything is active, and poll the
     checkpoint watcher every ``reload_s`` — params swap BETWEEN ticks, so a
@@ -389,11 +410,21 @@ def serve_loop(engine: ServingEngine, queue, *, watcher=None,
 
     ``health`` (a telemetry ``HealthMonitor``) is beaten once per loop
     iteration so its stall detector watches THIS thread — a hung jit'd tick
-    or a deadlocked admission path shows up in ``/healthz``."""
+    or a deadlocked admission path shows up in ``/healthz``.
+
+    ``injector`` (a resilience ``FaultInjector``) gets a
+    ``maybe_kill_replica`` call per iteration — the replica_kill drill's
+    hook. ``registrar`` (a serving ``FleetRegistrar``) is beaten per
+    iteration so the fleet lease stays fresh exactly while THIS thread is
+    alive — a wedged loop goes stale in the router's view."""
     last_reload = clock()
     while stop is None or not stop.is_set():
         if health is not None:
             health.beat()
+        if registrar is not None:
+            registrar.beat(engine.model_step or 0)
+        if injector is not None:
+            injector.maybe_kill_replica(engine.served)
         admitted = False
         while engine.free_slots > 0:
             req = queue.take()

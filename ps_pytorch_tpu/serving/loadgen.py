@@ -131,13 +131,107 @@ def run_open_loop(engine: ServingEngine, requests: List[Request], *,
             queue.submit(req)
         for req in requests:
             if not req.wait(timeout_s):
-                req._resolve("failed", "loadgen timeout")
-                record_terminal(req, reqtrace=engine.reqtrace,
-                                slo=engine.slo, now=engine.clock())
+                # First-wins CAS: the serve loop may resolve concurrently;
+                # only the winner records the terminal sample.
+                if req._resolve("failed", "loadgen timeout"):
+                    record_terminal(req, reqtrace=engine.reqtrace,
+                                    slo=engine.slo, now=engine.clock())
     finally:
         stop.set()
         loop.join(timeout=10.0)
     return summarize(requests, engine.clock() - t0)
+
+
+def http_post_generate(url: str, body: Dict,
+                       timeout_s: float = 30.0) -> tuple:
+    """POST one /v1/generate body to ``url``; returns (status, response).
+    Status 0 means the connection itself failed — client-visible
+    unavailability, the thing the router exists to prevent."""
+    import json
+    import urllib.error
+    import urllib.request
+    data = json.dumps(body).encode("utf-8")
+    req = urllib.request.Request(
+        url + "/v1/generate", data=data,
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout_s) as r:
+            return r.status, json.loads(r.read() or b"{}")
+    except urllib.error.HTTPError as e:
+        try:
+            payload = json.loads(e.read() or b"{}")
+        except ValueError:
+            payload = {}
+        return e.code, payload
+    except (urllib.error.URLError, OSError, TimeoutError) as e:
+        return 0, {"error": str(e)}
+
+
+def run_http_open_loop(url: str, n: int, *, rate_rps: float,
+                       prompt_len: int = 8, n_new: int = 16,
+                       vocab: int = 256, seed: int = 0,
+                       deadline_s: float = 30.0,
+                       timeout_s: float = 60.0) -> Dict:
+    """Open-loop Poisson load over HTTP — the fleet drill's client.
+
+    Unlike ``run_open_loop`` (in-process, one engine) this drives a real
+    listener — a replica or the router — with one thread per in-flight
+    request, so arrivals stay open-loop: a slow or dead backend does NOT
+    slow the arrival process, it grows the in-flight set (exactly the
+    regime where failover and hedging matter). Request ``i`` samples with
+    seed ``seed + i``, so replays are bit-reproducible end to end.
+
+    Returns client-side stats: per-status counts, strict availability
+    (completed / sent), and latency percentiles over completed requests.
+    """
+    rng = np.random.default_rng(seed)
+    gaps = rng.exponential(1.0 / rate_rps, size=n)
+    prompts = [rng.integers(0, vocab, size=prompt_len).tolist()
+               for _ in range(n)]
+    results: List[Optional[tuple]] = [None] * n
+    lat = [0.0] * n
+
+    def fire(i: int) -> None:
+        body = {"tokens": prompts[i], "n_new": n_new, "seed": seed + i,
+                "deadline_s": deadline_s}
+        t0 = time.monotonic()
+        results[i] = http_post_generate(url, body, timeout_s=timeout_s)
+        lat[i] = time.monotonic() - t0
+
+    threads = []
+    t_start = time.monotonic()
+    for i in range(n):
+        time.sleep(float(gaps[i]))
+        th = threading.Thread(target=fire, args=(i,), daemon=True,
+                              name=f"lg-http-{i}")
+        th.start()
+        threads.append(th)
+    for th in threads:
+        th.join(timeout=timeout_s + 10.0)
+    wall = time.monotonic() - t_start
+    status_counts: Dict[str, int] = {}
+    for r in results:
+        code = "none" if r is None else str(r[0])
+        status_counts[code] = status_counts.get(code, 0) + 1
+    done = [i for i, r in enumerate(results)
+            if r is not None and r[0] == 200]
+    failed_5xx = sum(v for k, v in status_counts.items()
+                     if k in ("none", "0") or k.startswith("5"))
+    out = {
+        "requests": n,
+        "completed": len(done),
+        "failed_5xx": int(failed_5xx),
+        "status_counts": status_counts,
+        "wall_s": float(wall),
+        "offered_rps": float(rate_rps),
+        "availability": len(done) / n if n else None,
+        "latency_p50_ms": None, "latency_p99_ms": None,
+    }
+    if len(done) >= MIN_PERCENTILE_SAMPLES:
+        ls = np.array([lat[i] for i in done])
+        out["latency_p50_ms"] = float(np.percentile(ls, 50) * 1e3)
+        out["latency_p99_ms"] = float(np.percentile(ls, 99) * 1e3)
+    return out
 
 
 def run_slo_sweep(engine: ServingEngine, slo_spec: str, *,

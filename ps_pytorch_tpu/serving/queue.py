@@ -47,18 +47,22 @@ class AdmissionQueue:
         self._nonempty = threading.Condition(self._lock)
         self.submitted = 0
         self.rejected_full = 0
+        self.rejected_closed = 0
         self.shed_deadline = 0
         self.taken = 0
+        self._closed = False
 
     def depth(self) -> int:
         with self._lock:
             return len(self._q)
 
-    def _shed_locked(self, req: Request, now: float) -> None:
+    def _shed_locked(self, req: Request, now: float,
+                     reason: str = "deadline passed while queued") -> None:
+        if not req._resolve("shed", reason):
+            return   # lost the terminal CAS; winner already recorded it
         self.shed_deadline += 1
         if self.registry is not None:
             self.registry.inc("serve_shed")
-        req._resolve("shed", "deadline passed while queued")
         record_terminal(req, reqtrace=self.reqtrace, slo=self.slo, now=now)
 
     def _reap_locked(self, now: float) -> int:
@@ -91,14 +95,22 @@ class AdmissionQueue:
         against requests that can still be served."""
         with self._lock:
             now = self.clock()
+            if self._closed:
+                self.rejected_closed += 1
+                if req._resolve("rejected", "draining"):
+                    if self.registry is not None:
+                        self.registry.inc("serve_rejected")
+                    record_terminal(req, reqtrace=self.reqtrace,
+                                    slo=self.slo, now=now)
+                return False
             self._reap_locked(now)
             if len(self._q) >= self.max_depth:
                 self.rejected_full += 1
-                if self.registry is not None:
-                    self.registry.inc("serve_rejected")
-                req._resolve("rejected", "queue full")
-                record_terminal(req, reqtrace=self.reqtrace, slo=self.slo,
-                                now=now)
+                if req._resolve("rejected", "queue full"):
+                    if self.registry is not None:
+                        self.registry.inc("serve_rejected")
+                    record_terminal(req, reqtrace=self.reqtrace,
+                                    slo=self.slo, now=now)
                 return False
             req.state = "queued"
             if not req.t_submit:
@@ -122,6 +134,31 @@ class AdmissionQueue:
                 self.taken += 1
                 return req
         return None
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def close(self, reason: str = "server stopping") -> int:
+        """Stop admitting (drain mode): subsequent ``submit`` calls resolve
+        ``rejected`` immediately, and every request still queued is shed
+        NOW with ``reason`` so its waiting server thread unblocks instead
+        of parking until its wait-timeout. Returns how many were shed.
+        Idempotent."""
+        with self._lock:
+            self._closed = True
+            now = self.clock()
+            shed = 0
+            while self._q:
+                self._shed_locked(self._q.popleft(), now, reason)
+                shed += 1
+            self._nonempty.notify_all()
+            return shed
+
+    def reopen(self) -> None:
+        """Leave drain mode (the rolling-reload resume path)."""
+        with self._lock:
+            self._closed = False
 
     def wait_nonempty(self, timeout: float) -> bool:
         """Block up to ``timeout`` for the queue to become non-empty (the

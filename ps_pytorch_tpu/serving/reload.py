@@ -45,6 +45,9 @@ class CheckpointWatcher:
         self.reloads = 0
         self.skipped_corrupt = 0
         self.poll_count = 0
+        # Newest step already counted into skipped_corrupt — a corrupt
+        # newest checkpoint is ONE corruption event, not one per poll.
+        self._skip_counted = -1
         # Meta of the newest loaded checkpoint — elastic training runs
         # stamp leader_epoch/leader_pid here, and /healthz surfaces which
         # leadership epoch produced the weights currently being served.
@@ -62,12 +65,17 @@ class CheckpointWatcher:
                                      migrate=self.migrate)
         if got is None:
             # Everything newer (indeed everything) is corrupt: keep serving
-            # what we have.
-            self.skipped_corrupt += 1
+            # what we have. Count the newest step once, not every poll —
+            # the counter tracks corruption EVENTS, and the same corrupt
+            # newest re-observed is the same event.
+            if newest != self._skip_counted:
+                self.skipped_corrupt += 1
+                self._skip_counted = newest
             return None
         state, meta, config_json, step = got
-        if step < newest:
+        if step < newest and newest != self._skip_counted:
             self.skipped_corrupt += 1
+            self._skip_counted = newest
         if step <= self.loaded_step:
             return None     # newest valid is what we already serve
         self.loaded_step = step

@@ -286,6 +286,11 @@ SERVING_COUNTERS = (
     ("serve_rejected", "requests", "requests rejected at admission (queue full)"),
     ("serve_shed", "requests", "requests shed for a passed deadline"),
     ("serve_reloads", "events", "hot checkpoint reloads applied"),
+    ("serve_resolve_races", "events", "terminal resolutions that lost the "
+                                      "first-wins CAS (double-resolve "
+                                      "attempts suppressed)"),
+    ("serve_rejected_oversize", "requests", "requests rejected for an "
+                                            "oversized or malformed body"),
     ("slo_violations", "events", "per-request SLO objective violations"),
 )
 SERVING_GAUGES = (
@@ -311,6 +316,51 @@ def declare_serving_metrics(registry: Registry) -> Registry:
     for name, unit, help_ in SERVING_GAUGES:
         registry.gauge(name, unit=unit, help=help_)
     for name, unit, help_ in SERVING_HISTOGRAMS:
+        registry.histogram(name, unit=unit, help=help_)
+    return registry
+
+
+# ---- router metric contract (ps_pytorch_tpu/serving/router.py) ----
+#
+# The fleet front-end's view: routed request outcomes, failover retries,
+# hedged backups, and backend health transitions. Routed availability
+# (router_requests vs router_failed) is what the SLO burn-rate engine
+# consumes at the router — the client-visible number, not any one
+# replica's.
+ROUTER_COUNTERS = (
+    ("router_requests", "requests", "requests routed to completion"),
+    ("router_failed", "requests", "requests that exhausted retries and "
+                                  "surfaced an error to the client"),
+    ("router_retries", "attempts", "failover re-dispatches to a different "
+                                   "replica after a retryable failure"),
+    ("router_hedges", "requests", "hedged backup requests issued past the "
+                                  "tail-latency threshold"),
+    ("router_hedge_wins", "requests", "hedged backups that beat the "
+                                      "primary attempt"),
+    ("router_hedge_cancelled", "requests", "hedge losers cancelled after "
+                                           "the first response won"),
+    ("router_backend_ejections", "events", "backends marked unhealthy "
+                                           "(probe/lease/forward failure)"),
+)
+ROUTER_GAUGES = (
+    ("router_backends_ready", "replicas", "backends currently health-gated "
+                                          "ready"),
+    ("router_outstanding", "requests", "requests in flight across all "
+                                       "backends"),
+)
+ROUTER_HISTOGRAMS = (
+    ("router_request_latency_s", "s", "routed submit -> response latency "
+                                      "(includes retries and hedges)"),
+)
+
+
+def declare_router_metrics(registry: Registry) -> Registry:
+    """Declare the router counters/gauges/histograms on ``registry``."""
+    for name, unit, help_ in ROUTER_COUNTERS:
+        registry.counter(name, unit=unit, help=help_)
+    for name, unit, help_ in ROUTER_GAUGES:
+        registry.gauge(name, unit=unit, help=help_)
+    for name, unit, help_ in ROUTER_HISTOGRAMS:
         registry.histogram(name, unit=unit, help=help_)
     return registry
 

@@ -138,6 +138,19 @@ FAMILIES: Dict[str, dict] = {
         "min_hierarchy": [("partitions", 1), ("regrafts", 1),
                           ("degraded_steps", 1)],
     },
+    "router": {
+        # Same artifact series, gating the fleet-serving drill
+        # (tools/router_drill.py): the newest RESILIENCE_r*.json carrying
+        # a "router" section must show a replica actually SIGKILLed under
+        # open-loop load with zero client-visible 5xx and availability at
+        # or above the floor recorded in the artifact, a rolling reload
+        # across >= 3 replicas with zero failed requests and the served
+        # model_step advanced everywhere, and hedged dispatch beating
+        # no-hedge p99 on the jittered-backend bench.
+        "pattern": "RESILIENCE_r[0-9]*.json",
+        "metrics": [],              # invariant check, see _check_router
+        "bools": ["bitwise_equal", "ok"],
+    },
 }
 
 
@@ -199,6 +212,8 @@ def compare(family: str, baseline, candidate) -> dict:
         return _check_elastic(spec, candidate)
     if family == "hierarchy":
         return _check_hierarchy(spec, candidate)
+    if family == "router":
+        return _check_router(spec, candidate)
     if family == "ops":
         return _check_ops(spec, candidate)
     if family == "slo":
@@ -402,6 +417,62 @@ def _check_hierarchy(spec: dict, candidate) -> dict:
             "configs": {"invariants": {"ok": ok, "metrics": checks}}}
 
 
+def _check_router(spec: dict, candidate) -> dict:
+    doc = candidate if isinstance(candidate, dict) else \
+        (candidate[0] if candidate else {})
+    checks: Dict[str, dict] = {}
+    ok = True
+    router = doc.get("router")
+    if not isinstance(router, dict):
+        return {"family": "router", "ok": False,
+                "configs": {"invariants": {"ok": False, "metrics": {
+                    "_router": {"ok": False,
+                                "note": "artifact has no router "
+                                        "section"}}}}}
+    for key in spec["bools"]:
+        if key in doc:
+            checks[key] = {"cand": doc[key], "ok": bool(doc[key])}
+            ok = ok and checks[key]["ok"]
+    # kill phase: a replica really died under load, clients never saw it
+    kill = router.get("kill", {})
+    floor = float(kill.get("availability_floor", 0.99))
+    avail = kill.get("availability")
+    checks["kill_availability"] = {
+        "cand": avail, "floor": floor,
+        "ok": avail is not None and float(avail) >= floor}
+    checks["replica_kills"] = {
+        "cand": int(kill.get("replica_kills", 0)), "floor": 1,
+        "ok": int(kill.get("replica_kills", 0)) >= 1}
+    checks["kill_zero_5xx"] = {
+        "cand": int(kill.get("failed_5xx", -1)),
+        "ok": int(kill.get("failed_5xx", -1)) == 0}
+    # rolling reload: zero failed requests, every replica on the new step
+    reload_ = router.get("reload", {})
+    checks["reload_zero_failed"] = {
+        "cand": int(reload_.get("failed_5xx", -1)),
+        "ok": (int(reload_.get("failed_5xx", -1)) == 0
+               and int(reload_.get("requests", 0)) > 0)}
+    checks["replicas_rolled"] = {
+        "cand": int(reload_.get("replicas_rolled", 0)), "floor": 3,
+        "ok": int(reload_.get("replicas_rolled", 0)) >= 3}
+    checks["model_step_advanced"] = {
+        "cand": bool(reload_.get("model_step_advanced", False)),
+        "ok": bool(reload_.get("model_step_advanced", False))}
+    # hedging: backup requests must lower routed p99 on the jittered bench
+    hedge = router.get("hedge", {})
+    ratio = hedge.get("p99_ratio")
+    checks["hedge_p99_ratio"] = {
+        "cand": ratio, "ceiling": 1.0,
+        "ok": ratio is not None and float(ratio) < 1.0}
+    checks["hedges_fired"] = {
+        "cand": int(hedge.get("hedges", 0)), "floor": 1,
+        "ok": int(hedge.get("hedges", 0)) >= 1}
+    for c in checks.values():
+        ok = ok and c["ok"]
+    return {"family": "router", "ok": ok,
+            "configs": {"invariants": {"ok": ok, "metrics": checks}}}
+
+
 def run_gate(family: str, candidate_path: str, repo: str = ".",
              baseline_path: str = "") -> dict:
     """Gate one candidate artifact against the newest committed baseline
@@ -411,7 +482,7 @@ def run_gate(family: str, candidate_path: str, repo: str = ".",
     candidate = load_artifact(candidate_path)
     baseline = None
     if family not in ("resilience", "ops", "slo", "wire_codec",
-                      "hierarchy"):
+                      "hierarchy", "router"):
         if baseline_path:
             baseline = load_artifact(baseline_path)
         else:
@@ -442,7 +513,7 @@ def run_all(repo: str = ".") -> dict:
             families[family] = {"family": family, "ok": True,
                                 "note": "no committed artifacts; skipped"}
             continue
-        if family in ("elastic", "hierarchy"):
+        if family in ("elastic", "hierarchy", "router"):
             # Gate the newest artifact that actually ran this drill
             # (older RESILIENCE rounds predate the subsystem).
             with_section = [p for p in paths if isinstance(

@@ -240,6 +240,24 @@ def collect(repo: str):
             "crashes": c.get("crashes"),
             "kv_retries": c.get("kv_retries"),
             "ok": d.get("ok") is True and "_parse_error" not in d})
+        router = d.get("router")
+        if isinstance(router, dict):
+            # Fleet-serving evidence (tools/router_drill.py): SIGKILL
+            # under Poisson load absorbed by failover, rolling reload with
+            # zero failed requests, hedging beating no-hedge p99.
+            kill = router.get("kill") or {}
+            hedge = router.get("hedge") or {}
+            reload_ = router.get("reload") or {}
+            add("fleet serving", p, {
+                "value": kill.get("availability"),
+                "unit": "availability under replica SIGKILL",
+                "platform": d.get("platform"),
+                "replicas": router.get("replicas"),
+                "hedge_p99_ratio": hedge.get("p99_ratio"),
+                "ok": (d.get("ok") is True
+                       and int(kill.get("failed_5xx", -1)) == 0
+                       and int(reload_.get("failed_5xx", -1)) == 0
+                       and bool(reload_.get("model_step_advanced")))})
     p = _newest("BENCH_WIRE_r[0-9]*.json", repo)
     if p:
         # Wire-overlap evidence (bench_suite wire_blocking_*/wire_overlapped_*

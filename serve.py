@@ -14,11 +14,21 @@ walked past, same contract as training resume).
 Model geometry comes from the checkpoint's own config; the ``--serve-*``
 flags (config.py) size the engine. Byte-level LM: "prompt" is UTF-8 text;
 send "tokens" (int list) for non-byte vocabularies.
+
+Fleet mode: pass ``--serve-kv-dir <dir> --serve-replica-id <i>`` and the
+replica registers itself in the directory-backed coordination KV
+(``serve/<fleet>/replica/<i>``) and beats a liveness lease from the serve
+loop; ``tools/router.py``-less fleets just point the Router's FleetView at
+the same dir. SIGTERM triggers graceful drain (stop admitting, finish
+in-flight, deregister, exit) — the zero-downtime half of a rolling
+restart. ``--fault-spec "replica_kill:served=20,r=<i>"`` arms the
+drill's SIGKILL.
 """
 
 import argparse
 import dataclasses
 import json
+import signal
 import sys
 import time
 
@@ -88,12 +98,11 @@ def main(argv=None) -> int:
         n_layers=geo["n_layers"], n_heads=geo["n_heads"],
         max_seq_len=geo["max_seq_len"], model_step=step, registry=registry,
         reqtrace=reqtrace, slo=slo)
-    watcher = None
-    if args.serve_reload_s > 0:
-        watcher = CheckpointWatcher(args.train_dir, template,
-                                    to_tree=to_tree,
-                                    migrate=migrate_packed_qkv,
-                                    start_step=step)
+    # Always build the watcher: the periodic poll is gated by
+    # --serve-reload-s, but POST /admin/reload (the rolling-reload driver)
+    # force-polls regardless.
+    watcher = CheckpointWatcher(args.train_dir, template, to_tree=to_tree,
+                                migrate=migrate_packed_qkv, start_step=step)
     # Watchdog over the serve loop: the stall detector notices a wedged
     # drive thread (health.beat() runs once per loop iteration) and the
     # state shows up under /healthz's "health" key.
@@ -106,11 +115,27 @@ def main(argv=None) -> int:
     for k in ("leader_epoch", "leader_pid"):
         if k in meta:
             identity[k] = meta[k]
+    # Fleet plane: registrar (KV record + liveness lease, beaten by the
+    # serve loop) and the replica_kill fault injector for the drill.
+    registrar = None
+    if args.serve_kv_dir:
+        from ps_pytorch_tpu.runtime.coordinator import FileKV
+        from ps_pytorch_tpu.serving.router import FleetRegistrar
+        registrar = FleetRegistrar(FileKV(args.serve_kv_dir),
+                                   args.serve_fleet, args.serve_replica_id)
+        identity["replica_id"] = args.serve_replica_id
+    injector = None
+    if args.fault_spec:
+        from ps_pytorch_tpu.resilience.faults import FaultInjector
+        injector = FaultInjector(args.fault_spec,
+                                 process_index=args.serve_replica_id)
     frontend = ServingFrontend(
         engine, watcher=watcher, host=args.serve_host, port=args.serve_port,
         max_queue=args.serve_max_queue, reload_s=args.serve_reload_s,
         default_deadline_s=args.serve_deadline_s,
-        default_n_new=args.serve_max_new, health=health, identity=identity)
+        default_n_new=args.serve_max_new, health=health, identity=identity,
+        max_body_bytes=args.serve_max_body_bytes, registrar=registrar,
+        injector=injector, advertise=args.serve_advertise)
     frontend.start()
     print(json.dumps({"serving": f"http://{args.serve_host}:{frontend.port}",
                       "metrics": f"http://{args.serve_host}:{frontend.port}"
@@ -118,12 +143,24 @@ def main(argv=None) -> int:
                       "model_step": step, "slots": args.serve_slots,
                       "vocab": geo["vocab_size"],
                       "seq_len": geo["max_seq_len"],
+                      "replica_id": (args.serve_replica_id
+                                     if registrar else None),
                       "slo_spec": args.slo_spec or None,
                       "reqtrace_keep": args.reqtrace_keep}))
     sys.stdout.flush()
+
+    # SIGTERM = graceful drain: stop admitting, finish in-flight slots,
+    # deregister from the fleet, exit 0 — a rolling restart never turns
+    # into client-visible errors.
+    draining = {"flag": False}
+
+    def _drain(signum, frame):
+        draining["flag"] = True
+
+    signal.signal(signal.SIGTERM, _drain)
     try:
-        while True:
-            time.sleep(3600)
+        while not draining["flag"]:
+            time.sleep(0.2)
     except KeyboardInterrupt:
         pass
     finally:

@@ -1,0 +1,586 @@
+"""Fleet serving plane (ps_pytorch_tpu/serving/router.py + friends).
+
+Control-plane pieces (FileKV, FleetRegistrar, FleetView) run on in-process
+KVs with a ManualClock — deterministic, no sleeps. The Router's failover /
+hedging paths run against REAL in-process ServingFrontends on real sockets
+(the unit-scale twin of tools/router_drill.py, which does the same over
+subprocesses and SIGKILL). Satellite contracts live here too: the request
+terminal-resolution CAS, the body-size bound, once-per-step corrupt-skip
+accounting, and graceful ServingFrontend.stop() under load.
+"""
+
+import json
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ps_pytorch_tpu.models.transformer import TransformerLM
+from ps_pytorch_tpu.resilience.faults import FaultInjector, ManualClock
+from ps_pytorch_tpu.runtime.coordinator import FileKV, KVStore
+from ps_pytorch_tpu.serving.engine import Request, ServingEngine
+from ps_pytorch_tpu.serving.router import FleetRegistrar, FleetView, Router
+from ps_pytorch_tpu.serving.server import ServingFrontend
+
+V, D, L, H, S = 61, 32, 2, 2, 96
+
+
+@pytest.fixture(scope="module")
+def params():
+    model = TransformerLM(vocab_size=V, d_model=D, n_layers=L, n_heads=H,
+                          max_seq_len=S)
+    return model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32),
+                      positions=jnp.arange(8))["params"]
+
+
+def _engine(params, slots, **kw):
+    return ServingEngine(params, slots=slots, vocab=V, d_model=D,
+                         n_layers=L, n_heads=H, max_seq_len=S, **kw)
+
+
+def _post(url, body, timeout=60):
+    req = urllib.request.Request(
+        f"{url}/v1/generate", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+# ---- FileKV ----
+
+def test_filekv_roundtrip_and_keys(tmp_path):
+    kv = FileKV(str(tmp_path / "kv"))
+    assert kv.get("missing") is None
+    assert kv.get("missing", "dflt") == "dflt"
+    kv.set("serve/f/replica/0", "a")
+    kv.set("serve/f/replica/1", "b")
+    kv.set("serve/f/hb/0", "c")
+    assert kv.get("serve/f/replica/0") == "a"
+    assert kv.keys("serve/f/replica/") == ["serve/f/replica/0",
+                                           "serve/f/replica/1"]
+    kv.set("serve/f/replica/0", "a2")       # overwrite is atomic replace
+    assert kv.get("serve/f/replica/0") == "a2"
+    kv.delete("serve/f/replica/0")
+    kv.delete("serve/f/replica/0")          # idempotent
+    assert kv.get("serve/f/replica/0") is None
+    assert kv.keys("serve/f/replica/") == ["serve/f/replica/1"]
+
+
+def test_filekv_shared_across_instances(tmp_path):
+    """Two FileKV handles on one dir see each other's writes — the whole
+    point (replica and router are different processes)."""
+    a = FileKV(str(tmp_path / "kv"))
+    b = FileKV(str(tmp_path / "kv"))
+    a.set("k/with/slashes and spaces", "v")
+    assert b.get("k/with/slashes and spaces") == "v"
+    assert b.keys("k/") == ["k/with/slashes and spaces"]
+
+
+# ---- replica_kill fault ----
+
+def test_replica_kill_spec_parse_and_validate():
+    inj = FaultInjector("replica_kill:served=20,r=1", process_index=1)
+    assert inj.faults[0]["kind"] == "replica_kill"
+    assert inj.faults[0]["served"] == 20 and inj.faults[0]["r"] == 1
+    inj2 = FaultInjector("replica_kill:served=5")       # r defaults 0
+    assert inj2.faults[0]["r"] == 0
+    with pytest.raises(ValueError, match="served"):
+        FaultInjector("replica_kill:r=0")
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        FaultInjector("replica_nuke:served=5")
+
+
+def test_replica_kill_gates_and_fires_once(monkeypatch):
+    import ps_pytorch_tpu.resilience.faults as faults_mod
+    kills = []
+    monkeypatch.setattr(faults_mod.os, "kill",
+                        lambda pid, sig: kills.append((pid, sig)))
+    # wrong process index: never fires
+    other = FaultInjector("replica_kill:served=3,r=1", process_index=0)
+    other.maybe_kill_replica(100)
+    assert kills == [] and other.counters["replica_kills"] == 0
+    # right index: below threshold no, at threshold once, then never again
+    inj = FaultInjector("replica_kill:served=3,r=1", process_index=1)
+    inj.maybe_kill_replica(2)
+    assert kills == []
+    inj.maybe_kill_replica(3)
+    inj.maybe_kill_replica(50)
+    assert len(kills) == 1 and inj.counters["replica_kills"] == 1
+
+
+# ---- FleetRegistrar ----
+
+def test_registrar_record_lease_and_incarnation():
+    clock, kv = ManualClock(), KVStore()
+    reg = FleetRegistrar(kv, "f", 2, clock=clock.time)
+    rec = reg.register(url="http://127.0.0.1:9", model_step=5)
+    assert rec["incarnation"] == 0 and rec["state"] == "ready"
+    stored = json.loads(kv.get("serve/f/replica/2"))
+    assert stored["url"] == "http://127.0.0.1:9"
+    assert stored["model_step"] == 5
+    step, _ = json.loads(kv.get("serve/f/hb/2"))    # lease exists
+    assert step == 5
+
+    reg.set_state("draining")
+    assert json.loads(kv.get("serve/f/replica/2"))["state"] == "draining"
+
+    # a restart of the same id bumps incarnation (rejoin, not stale)
+    reg2 = FleetRegistrar(kv, "f", 2, clock=clock.time)
+    assert reg2.register(url="http://127.0.0.1:9")["incarnation"] == 1
+
+    reg2.deregister()
+    assert kv.get("serve/f/replica/2") is None
+    assert kv.get("serve/f/hb/2") is None
+
+
+def test_registrar_beat_is_throttled():
+    clock, kv = ManualClock(), KVStore()
+    reg = FleetRegistrar(kv, "f", 0, lease_interval_s=1.0, clock=clock.time)
+    reg.register(url="u")
+    assert not reg.beat(1)          # within interval: skipped
+    clock.advance(1.5)
+    assert reg.beat(2)              # past interval: published
+
+
+# ---- FleetView ----
+
+def _view(kv, clock, **kw):
+    kw.setdefault("probe", False)   # unit tests gate on record+lease only
+    return FleetView(kv, "f", lease_timeout_s=3.0, clock=clock.time, **kw)
+
+
+def test_fleetview_gates_on_state_and_lease():
+    clock, kv = ManualClock(), KVStore()
+    r0 = FleetRegistrar(kv, "f", 0, clock=clock.time)
+    r1 = FleetRegistrar(kv, "f", 1, clock=clock.time)
+    r0.register(url="http://h:1")
+    r1.register(url="http://h:2", state="starting")
+    view = _view(kv, clock)
+    ready = view.poll()
+    assert [b.id for b in ready] == [0]          # starting is gated out
+    r1.set_state("ready")
+    assert {b.id for b in view.poll()} == {0, 1}
+
+    # SIGKILL leaves the record saying "ready" but the lease goes stale
+    clock.advance(10.0)
+    r0.beat(0)                                    # only replica 0 survives
+    ready = view.poll()
+    assert [b.id for b in ready] == [0]
+    dead = next(b for b in view.backends() if b.id == 1)
+    assert not dead.lease_fresh and dead.state == "ready"
+
+    r0.set_state("draining")                      # planned: record flips
+    assert view.poll() == []
+
+
+def test_fleetview_preserves_identity_until_incarnation_bump():
+    clock, kv = ManualClock(), KVStore()
+    reg = FleetRegistrar(kv, "f", 0, clock=clock.time)
+    reg.register(url="http://h:1")
+    view = _view(kv, clock)
+    b1 = view.poll()[0]
+    b1.outstanding = 7            # router-owned runtime state
+    assert view.poll()[0] is b1   # same object across refreshes
+    assert b1.outstanding == 7
+    # restart (incarnation bump) resets the runtime fields
+    FleetRegistrar(kv, "f", 0, clock=clock.time).register(url="http://h:1")
+    b2 = view.poll()[0]
+    assert b2 is not b1 and b2.outstanding == 0 and b2.incarnation == 1
+
+
+def test_fleetview_eject_counts_once():
+    clock, kv = ManualClock(), KVStore()
+    FleetRegistrar(kv, "f", 0, clock=clock.time).register(url="http://h:1")
+    view = _view(kv, clock)
+    b = view.poll()[0]
+    view.eject(b)
+    view.eject(b)                 # second eject of an unhealthy backend
+    assert view.ejections == 1 and not b.ready
+
+
+# ---- Router over real in-process replicas ----
+
+def _fleet(params, n, kv, registry=None):
+    """n real ServingFrontends registered in ``kv``; returns frontends."""
+    fes = []
+    for rid in range(n):
+        reg = FleetRegistrar(kv, "f", rid)
+        fe = ServingFrontend(_engine(params, 2), port=0, max_queue=8,
+                             registrar=reg)
+        fe.start()
+        fes.append(fe)
+    return fes
+
+
+def test_router_routes_and_balances(params, tmp_path):
+    kv = FileKV(str(tmp_path / "kv"))
+    fes = _fleet(params, 2, kv)
+    view = FleetView(kv, "f", lease_timeout_s=30.0)
+    router = Router(view, retries=2, backoff_s=0.01)
+    try:
+        assert len(view.poll()) == 2
+        body = {"tokens": [1, 2, 3], "n_new": 5, "seed": 4,
+                "temperature": 0.7, "top_k": 5}
+        outs = [router.route(body) for _ in range(4)]
+        assert all(code == 200 for code, _ in outs)
+        # idempotence across replicas: same seed, same tokens, every time
+        toks = [o["tokens"] for _, o in outs]
+        assert all(t == toks[0] for t in toks)
+        # round-robin tie-break spread the requests over both replicas
+        assert {fe.engine.served > 0 for fe in fes} == {True}
+        assert router.counters["requests"] == 4
+        assert router.counters["failed"] == 0
+    finally:
+        for fe in fes:
+            fe.stop()
+
+
+def test_router_fails_over_dead_backend(params, tmp_path):
+    """A registered-but-unreachable replica (fresh lease, dead socket —
+    the instant after a SIGKILL) must cost a retry, never a client 5xx."""
+    kv = FileKV(str(tmp_path / "kv"))
+    fes = _fleet(params, 1, kv)
+    # dead replica: valid record + fresh lease, nothing listening
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        dead_port = s.getsockname()[1]
+    FleetRegistrar(kv, "f", 1).register(url=f"http://127.0.0.1:{dead_port}")
+    view = FleetView(kv, "f", lease_timeout_s=30.0, probe=False)
+    router = Router(view, retries=3, backoff_s=0.01)
+    try:
+        view.poll()
+        body = {"tokens": [1, 2, 3], "n_new": 4, "seed": 0}
+        for _ in range(4):      # rr tie-break guarantees both get picked
+            code, out = router.route(body)
+            assert code == 200, out
+        assert router.counters["failed"] == 0
+        assert router.counters["retries"] >= 1      # dead one cost a retry
+        assert view.ejections >= 1                  # and was ejected
+    finally:
+        fes[0].stop()
+
+
+def test_router_hedge_beats_straggler(params, tmp_path):
+    """Primary lands on a backend that accepts and never answers; the
+    hedge goes to the real replica and wins; the straggler is cancelled."""
+    kv = FileKV(str(tmp_path / "kv"))
+    fes = _fleet(params, 1, kv)
+    # straggler: accepts connections, never responds (SIGSTOP-alike)
+    lsock = socket.socket()
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(4)
+    held = []
+
+    def _hold():
+        try:
+            while True:
+                conn, _ = lsock.accept()
+                held.append(conn)       # keep open, never reply
+        except OSError:
+            pass
+
+    t = threading.Thread(target=_hold, daemon=True)
+    t.start()
+    FleetRegistrar(kv, "f", 1).register(
+        url=f"http://127.0.0.1:{lsock.getsockname()[1]}")
+    view = FleetView(kv, "f", lease_timeout_s=30.0, probe=False)
+    router = Router(view, retries=1, backoff_s=0.01, hedge_s=0.05,
+                    request_timeout_s=20.0)
+    try:
+        view.poll()
+        real = next(b for b in view.backends() if b.id == 0)
+        straggler = next(b for b in view.backends() if b.id == 1)
+        # force the primary pick onto the straggler
+        real.outstanding = 1
+        code, out = router.route({"tokens": [1, 2, 3], "n_new": 4,
+                                  "seed": 0})
+        real.outstanding = 0
+        assert code == 200
+        assert router.counters["hedges"] >= 1
+        assert router.counters["hedge_wins"] >= 1
+        assert router.counters["hedge_cancelled"] >= 1
+        # loser bookkeeping closes once its blocked read errors out
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and straggler.outstanding:
+            time.sleep(0.01)
+        assert straggler.outstanding == 0
+    finally:
+        lsock.close()
+        for c in held:
+            c.close()
+        fes[0].stop()
+
+
+def test_router_503_when_no_backends(tmp_path):
+    view = FleetView(FileKV(str(tmp_path / "kv")), "f")
+    router = Router(view, retries=1, backoff_s=0.01)
+    code, out = router.route({"tokens": [1], "n_new": 1})
+    assert code == 503 and "no ready backends" in out["error"]
+    assert router.counters["failed"] == 1
+
+
+def test_router_does_not_retry_client_errors(params, tmp_path):
+    kv = FileKV(str(tmp_path / "kv"))
+    fes = _fleet(params, 2, kv)
+    view = FleetView(kv, "f", lease_timeout_s=30.0)
+    router = Router(view, retries=3, backoff_s=0.01)
+    try:
+        view.poll()
+        code, out = router.route({"tokens": [1, 2], "n_new": 0})
+        assert code == 400
+        assert router.counters["retries"] == 0
+    finally:
+        for fe in fes:
+            fe.stop()
+
+
+# ---- replica readiness / drain / reload plane ----
+
+def test_readyz_and_drain_resume(params):
+    with ServingFrontend(_engine(params, 2), port=0, max_queue=4) as fe:
+        url = f"http://127.0.0.1:{fe.port}"
+        with urllib.request.urlopen(f"{url}/readyz", timeout=10) as r:
+            body = json.loads(r.read())
+            assert r.status == 200 and body["ready"] and \
+                body["state"] == "ready"
+
+        # drain: readiness 503, submits rejected as retryable 503
+        req = urllib.request.Request(f"{url}/admin/drain", data=b"")
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert json.loads(r.read())["state"] == "draining"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"{url}/readyz", timeout=10)
+        assert ei.value.code == 503
+        assert json.loads(ei.value.read())["state"] == "draining"
+        code, out = _post(url, {"tokens": [1, 2], "n_new": 2})
+        assert code == 503
+
+        req = urllib.request.Request(f"{url}/admin/resume", data=b"")
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert json.loads(r.read())["state"] == "ready"
+        code, out = _post(url, {"tokens": [1, 2], "n_new": 2})
+        assert code == 200
+
+
+def test_drain_shed_is_retryable_503(params):
+    """Requests sitting in the queue when drain lands must come back 503
+    (another replica can serve them), NOT 504 (deadline's fault)."""
+    eng = _engine(params, 1)
+    fe = ServingFrontend(eng, port=0, max_queue=8)
+    fe.start()
+    url = f"http://127.0.0.1:{fe.port}"
+    results = []
+
+    def _go():
+        results.append(_post(url, {"tokens": [1, 2, 3], "n_new": 30,
+                                   "seed": 1}))
+
+    threads = [threading.Thread(target=_go) for _ in range(4)]
+    for t in threads:
+        t.start()
+    # wait until the single slot is busy and the rest are queued
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and fe.queue.depth() < 2:
+        time.sleep(0.01)
+    fe.drain()
+    for t in threads:
+        t.join(timeout=30)
+    assert not any(t.is_alive() for t in threads)
+    codes = sorted(c for c, _ in results)
+    assert set(codes) <= {200, 503}       # finished in-flight, or shed
+    assert 503 in codes                   # something WAS queued and shed
+    fe.stop()
+
+
+def test_frontend_stop_resolves_queued_and_inflight(params):
+    """stop() with a busy slot and a queue: every parked HTTP caller
+    unblocks with a terminal response — no hung threads, no lost waits."""
+    eng = _engine(params, 1)
+    fe = ServingFrontend(eng, port=0, max_queue=8)
+    fe.start()
+    url = f"http://127.0.0.1:{fe.port}"
+    results = []
+
+    def _go():
+        try:
+            results.append(_post(url, {"tokens": [1, 2, 3], "n_new": 40,
+                                       "seed": 1}, timeout=30))
+        except (urllib.error.URLError, ConnectionError, OSError):
+            results.append((0, {}))     # socket torn by shutdown: resolved
+
+    threads = [threading.Thread(target=_go) for _ in range(5)]
+    for t in threads:
+        t.start()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and \
+            (eng.active_count == 0 or fe.queue.depth() < 2):
+        time.sleep(0.01)
+    fe.stop(drain_timeout_s=20.0)
+    for t in threads:
+        t.join(timeout=30)
+    assert not any(t.is_alive() for t in threads)
+    assert len(results) == 5
+    assert fe.state == "dead"
+    assert eng.active_count == 0 and fe.queue.depth() == 0
+    # drained slot work completed; queued work shed as 503
+    assert all(c in (0, 200, 503) for c, _ in results)
+
+
+def test_rolling_reload_advances_model_step(params, tmp_path):
+    """Router.roll_reload across a 2-replica fleet: drain → reload →
+    resume each; both end ready on the NEW step; zero failed requests."""
+    import os
+
+    from ps_pytorch_tpu.config import TrainConfig
+    from ps_pytorch_tpu.runtime import checkpoint as ckpt
+    from ps_pytorch_tpu.runtime.lm_eval import build_lm_template
+    from ps_pytorch_tpu.serving.reload import CheckpointWatcher
+
+    cfg = TrainConfig(network="TransformerLM", lm_vocab=V, lm_d_model=D,
+                      lm_layers=L, lm_heads=H, lm_seq_len=S,
+                      train_dir=str(tmp_path / "ckpt"))
+    template = build_lm_template(cfg)
+    ckpt.save_checkpoint(cfg.train_dir, 1, template.replace(params=params),
+                         config_json=cfg.to_json())
+    kv = FileKV(str(tmp_path / "kv"))
+    fes = []
+    for rid in range(2):
+        watcher = CheckpointWatcher(cfg.train_dir, template, start_step=1)
+        fe = ServingFrontend(
+            _engine(params, 2, model_step=1), watcher=watcher, port=0,
+            max_queue=8, registrar=FleetRegistrar(kv, "f", rid))
+        fe.start()
+        fes.append(fe)
+    view = FleetView(kv, "f", lease_timeout_s=30.0)
+    router = Router(view, retries=2, backoff_s=0.01)
+    try:
+        assert len(view.poll()) == 2
+        ckpt.save_checkpoint(cfg.train_dir, 2,
+                             template.replace(params=params),
+                             config_json=cfg.to_json())
+        results = router.roll_reload(settle_timeout_s=20.0)
+        assert [r["ok"] for r in results] == [True, True]
+        assert [r["reloaded"] for r in results] == [True, True]
+        assert all(fe.engine.model_step == 2 for fe in fes)
+        assert all(fe.state == "ready" for fe in fes)
+        code, _ = router.route({"tokens": [1, 2], "n_new": 2})
+        assert code == 200 and router.counters["failed"] == 0
+    finally:
+        for fe in fes:
+            fe.stop()
+
+
+# ---- terminal-resolution CAS (satellite) ----
+
+def test_request_resolve_first_wins():
+    req = Request(prompt=np.ones(3, np.int32), n_new=2)
+    assert req._resolve("done")                  # winner
+    assert not req._resolve("failed", "late")    # loser: no overwrite
+    assert req.state == "done" and not req.error
+    assert req.wait(0.1)
+
+
+def test_lost_race_counted(params):
+    from ps_pytorch_tpu.telemetry.registry import (
+        Registry, declare_serving_metrics,
+    )
+    registry = declare_serving_metrics(Registry())
+    eng = _engine(params, 1, registry=registry)
+    req = Request(prompt=np.ones(3, np.int32), n_new=2)
+    eng.admit(req)
+    # the HTTP thread's wait-timeout resolves first...
+    assert req._resolve("failed", "server wait timeout")
+    while eng.active_count:     # ...then the serve loop finishes the slot
+        eng.step()
+    assert req.state == "failed"                 # loop did NOT overwrite
+    assert registry.snapshot()["serve_resolve_races"] == 1
+    assert eng.served == 0                       # not double-counted
+
+
+# ---- body-size bound (satellite) ----
+
+def _raw_http(port, raw: bytes) -> int:
+    with socket.create_connection(("127.0.0.1", port), timeout=10) as s:
+        s.sendall(raw)
+        data = s.recv(1024)
+    return int(data.split(b" ", 2)[1])
+
+
+def test_body_size_bound(params):
+    eng = _engine(params, 1)
+    with ServingFrontend(eng, port=0, max_queue=4,
+                         max_body_bytes=256) as fe:
+        url = f"http://127.0.0.1:{fe.port}"
+        # oversized: 413 BEFORE the body is read
+        big = {"tokens": [1] * 500, "n_new": 1}
+        code, out = _post(url, big)
+        assert code == 413 and "body" in out["error"]
+        # missing Content-Length: 400
+        code = _raw_http(fe.port, b"POST /v1/generate HTTP/1.1\r\n"
+                                  b"Host: x\r\n\r\n")
+        assert code == 400
+        # garbage Content-Length: 400
+        code = _raw_http(fe.port, b"POST /v1/generate HTTP/1.1\r\n"
+                                  b"Host: x\r\nContent-Length: ha\r\n\r\n")
+        assert code == 400
+        # well-formed small request still fine
+        code, out = _post(url, {"tokens": [1, 2], "n_new": 2})
+        assert code == 200
+        assert fe.stats()["served"] == 1
+
+
+def test_oversize_counter(params):
+    from ps_pytorch_tpu.telemetry.registry import (
+        Registry, declare_serving_metrics,
+    )
+    registry = declare_serving_metrics(Registry())
+    eng = _engine(params, 1, registry=registry)
+    with ServingFrontend(eng, port=0, max_queue=4,
+                         max_body_bytes=64) as fe:
+        url = f"http://127.0.0.1:{fe.port}"
+        code, _ = _post(url, {"tokens": [1] * 200, "n_new": 1})
+        assert code == 413
+        assert registry.snapshot()["serve_rejected_oversize"] == 1
+
+
+# ---- corrupt-skip accounting (satellite) ----
+
+def test_skipped_corrupt_counted_once_per_step(params, tmp_path):
+    import os
+
+    from ps_pytorch_tpu.config import TrainConfig
+    from ps_pytorch_tpu.resilience.faults import corrupt_file
+    from ps_pytorch_tpu.runtime import checkpoint as ckpt
+    from ps_pytorch_tpu.runtime.lm_eval import build_lm_template
+    from ps_pytorch_tpu.serving.reload import CheckpointWatcher
+
+    cfg = TrainConfig(network="TransformerLM", lm_vocab=V, lm_d_model=D,
+                      lm_layers=L, lm_heads=H, lm_seq_len=S,
+                      train_dir=str(tmp_path))
+    template = build_lm_template(cfg)
+    p2 = ckpt.save_checkpoint(cfg.train_dir, 2,
+                              template.replace(params=params),
+                              config_json=cfg.to_json())
+    corrupt_file(os.path.join(p2, "state.msgpack"), "truncate")
+    watcher = CheckpointWatcher(cfg.train_dir, template, start_step=1)
+    for _ in range(5):                    # a 1 Hz poll loop, not 5 corruptions
+        assert watcher.poll() is None
+    assert watcher.skipped_corrupt == 1
+    # a NEW corrupt step is a new event
+    p3 = ckpt.save_checkpoint(cfg.train_dir, 3,
+                              template.replace(params=params),
+                              config_json=cfg.to_json())
+    corrupt_file(os.path.join(p3, "state.msgpack"), "truncate")
+    for _ in range(3):
+        assert watcher.poll() is None
+    assert watcher.skipped_corrupt == 2
+    assert watcher.reloads == 0
