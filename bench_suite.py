@@ -1189,6 +1189,71 @@ def bench_ops_overhead(name, steps, *, batch=256, reps=3):
             "overhead_frac": round(frac, 5), "ok": frac < 0.02}
 
 
+def bench_integrity_overhead(name, steps, *, batch=256, reps=3):
+    """Gradient-integrity cost row: the SAME jitted LeNet step loop timed
+    bare and with the full per-step integrity work the async PS leader
+    adds — wire digests over every armoured chunk on BOTH sides (the
+    writer's stamp and the reader's verify, for all 4 contributors) plus
+    the compressed-domain screen (validators + norms + MAD gate +
+    quarantine bookkeeping) over one 4-contributor round. Payload encode
+    and armouring are NOT in the delta — the homomorphic wire pays those
+    with or without integrity. One process does all 4 contributors' digest
+    work here, so the row is an upper bound on any single process's share;
+    the budget asserted (and enforced by tools/regress.py) is <2%."""
+    from ps_pytorch_tpu.compression.codecs import encode_leaves
+    from ps_pytorch_tpu.parallel.transport import _encode_leaf
+    from ps_pytorch_tpu.resilience.integrity import (
+        GradIntegrity, verify_digest, wire_digest,
+    )
+
+    state0, step_fn, x, y, mask = _build("LeNet", "synthetic_mnist", batch,
+                                         n_devices=1)
+    # One round of LeNet-gradient-shaped int8lat contributions, encoded
+    # and armoured once up front (that cost exists regardless).
+    rng = np.random.default_rng(0)
+    grad_leaves = [rng.standard_normal(l.shape).astype(np.float32) * 0.01
+                   for l in jax.tree.leaves(state0.params)]
+    contribs, chunks = [], []
+    for sid in range(4):
+        payloads = encode_leaves("int8lat", grad_leaves, slice_id=sid,
+                                 step=0)
+        contribs.append((sid, payloads))
+        chunks.append([c for p in payloads
+                       for c in _encode_leaf(p, 3, "blosc")])
+    wire_chunks = sum(len(c) for c in chunks)
+
+    def run(integrity) -> float:
+        state = jax.tree.map(jnp.copy, state0)
+        gi = GradIntegrity() if integrity else None
+        for i in range(3):
+            state, metrics = step_fn(state, x, y, mask, jax.random.key(i))
+        jax.block_until_ready(state.params)
+        t0 = time.perf_counter()
+        for i in range(steps):
+            state, metrics = step_fn(state, x, y, mask,
+                                     jax.random.key(100 + i))
+            float(metrics["loss"])
+            if integrity:
+                for sid_chunks in chunks:
+                    toks = [wire_digest(c) for c in sid_chunks]
+                    assert all(verify_digest(c, t)
+                               for c, t in zip(sid_chunks, toks))
+                admitted, _ = gi.screen(contribs, step=i)
+                assert len(admitted) == 4
+        jax.block_until_ready(state.params)
+        return time.perf_counter() - t0
+
+    baseline_s = min(run(False) for _ in range(reps))
+    integrity_s = min(run(True) for _ in range(reps))
+    frac = (integrity_s - baseline_s) / baseline_s
+    return {"config": name, "platform": jax.devices()[0].platform,
+            "steps": steps, "reps": reps, "global_batch": batch,
+            "contributors": 4, "wire_chunks": wire_chunks,
+            "baseline_s": round(baseline_s, 5),
+            "integrity_s": round(integrity_s, 5),
+            "overhead_frac": round(frac, 5), "ok": frac < 0.02}
+
+
 def bench_elastic_overhead(name, steps, *, batch=256, reps=3):
     """Elastic control-plane cost row: the SAME jitted LeNet step loop
     timed bare and with the full per-step elastic work the trainers add
@@ -1404,6 +1469,10 @@ CONFIGS = {
     # cost per step when no faults fire; same <2% posture as ops_overhead.
     "elastic_overhead": lambda steps: bench_elastic_overhead(
         "elastic_overhead", max(steps, 30)),
+    # gradient-integrity plane (resilience/integrity.py): per-step digest +
+    # screen cost for a 4-contributor round; same <2% posture.
+    "integrity_overhead": lambda steps: bench_integrity_overhead(
+        "integrity_overhead", max(steps, 30)),
     # -- hierarchical multi-hop sync (ISSUE 11, parallel/hierarchy.py):
     # flat star vs 2-tier tree over the per-link LatencyKV (fast
     # intra-group, 20-50 ms inter-region). Each row carries BOTH legs;

@@ -41,6 +41,16 @@ if not hasattr(_jax, "shard_map"):
 
     _jax.shard_map = _compat_shard_map
 
+try:
+    # Sharding-invariant RNG: legacy (non-partitionable) threefry generates
+    # DIFFERENT bits when an init is jitted with sharded out_shardings (the
+    # row-parallel TP/PP param inits), so sharded and unsharded inits of the
+    # same seed diverged. The partitionable generator — the default on newer
+    # jax — produces identical bits under any sharding.
+    _jax.config.update("jax_threefry_partitionable", True)
+except Exception:
+    pass  # newer jax removed the flag (always partitionable)
+
 if not hasattr(_jax.lax, "axis_size"):
     # Older jax: no lax.axis_size. psum of a unit is the standard spelling
     # and constant-folds to the mesh axis size under shard_map/pjit.

@@ -130,6 +130,14 @@ class TrainConfig:
     auto_resume: int = 0             # max automatic restarts from the latest VALID checkpoint after a crash (train.py)
     leader_lease_s: float = 0.0      # leader refreshes a coordination-KV lease this often; followers raise LeaderLost when it goes stale (0 = lease off; runtime/coordinator.py)
 
+    # -- gradient integrity (resilience/integrity.py: wire digests are
+    #    always on — they ride the transport meta; these knobs govern the
+    #    leader-side pre-sum screen + contributor quarantine) --
+    grad_integrity: bool = True      # screen contributions (payload validators + MAD outlier gate) before the async/hier aggregation sum and quarantine repeat offenders
+    integrity_mad_threshold: float = 6.0  # robust z-score above which a contributor's grad norm is an outlier (one-sided; needs >= 4 contributors)
+    integrity_strike_limit: int = 3  # screened-out contributions before quarantine
+    integrity_readmit_clean: int = 3  # consecutive clean screens before a quarantined contributor is readmitted on probation
+
     # -- elastic control plane (ps_pytorch_tpu/elastic/: leader election,
     #    epoch'd membership, shard rebalancing; turns LeaderLost into a
     #    recovered event instead of a fatal one) --
@@ -256,6 +264,13 @@ class TrainConfig:
         if self.elastic_leader < 0:
             raise ValueError(f"elastic_leader={self.elastic_leader} "
                              "(must be >= 0)")
+        if self.integrity_mad_threshold <= 0:
+            raise ValueError(
+                f"integrity_mad_threshold={self.integrity_mad_threshold} "
+                "(must be > 0)")
+        if self.integrity_strike_limit < 1 or self.integrity_readmit_clean < 1:
+            raise ValueError("integrity_strike_limit / "
+                             "integrity_readmit_clean must be >= 1")
         if self.serve_slots < 1:
             raise ValueError(f"serve_slots={self.serve_slots} (must be >= 1)")
         if self.serve_max_queue < 1:

@@ -50,7 +50,7 @@ class StaleGradientAggregator:
                  compress: bool = False, codec_level: int = 3,
                  codec: str = "blosc", wire_bucket_bytes: int = 0,
                  wire_workers: int = 0, topk_frac: float = 0.01,
-                 error_feedback: bool = False):
+                 error_feedback: bool = False, integrity: Any = None):
         from ps_pytorch_tpu.compression.codecs import (
             EF_GRAD_CODECS, GRAD_CODECS, HOMOMORPHIC_GRAD_CODECS,
             require_codec,
@@ -97,6 +97,13 @@ class StaleGradientAggregator:
         self.wire_bucket_bytes = int(wire_bucket_bytes)
         self.wire_workers = int(wire_workers)
         self._executor = None
+        # Layer 2/3 of resilience/integrity.py (a GradIntegrity, or None =
+        # legacy behavior, bitwise-identical): collect() screens every
+        # pooled contribution BEFORE the K-of-N cutoff — validator or
+        # outlier rejects and quarantined contributors are demoted to
+        # "absent this round" and consumed, so one bad payload is one
+        # strike, not a strike per collect tick.
+        self.integrity = integrity
         # slice_id -> (step, leaves or compressed leaves, treedef)
         self._pool: Dict[int, Tuple[int, List[Any], Any]] = {}
 
@@ -256,6 +263,8 @@ class StaleGradientAggregator:
         """-> (weighted-average gradient pytree or None, info).
 
         info: {"used": [slice ids], "dropped_stale": [...], "weights": {...}}
+        (+ "rejected": {slice id: reason} when an integrity screen is
+        attached).
         """
         fresh = []
         dropped = []
@@ -265,16 +274,35 @@ class StaleGradientAggregator:
                 dropped.append(sid)
                 continue
             fresh.append((staleness, sid, leaves, treedef))
+        rejected: Dict[int, str] = {}
+        if self.integrity is not None and fresh:
+            # Screen BEFORE the K-of-N cutoff so a rejected contribution
+            # cannot eat a backup-worker slot, then consume rejects from
+            # the pool (demoted to "absent this round").
+            admitted, rejected = self.integrity.screen(
+                [(sid, leaves) for _, sid, leaves, _ in fresh],
+                step=current_step)
+            if rejected:
+                ok = set(admitted)
+                fresh = [t for t in fresh if t[1] in ok]
+                for sid in rejected:
+                    self._pool.pop(sid, None)
         # K freshest (stalest dropped first); ties -> lower slice id.
         fresh.sort(key=lambda t: (t[0], t[1]))
         if self.k > 0:
             fresh = fresh[:self.k]
         if not fresh:
-            return None, {"used": [], "dropped_stale": dropped, "weights": {}}
+            info = {"used": [], "dropped_stale": dropped, "weights": {}}
+            if self.integrity is not None:
+                info["rejected"] = rejected
+            return None, info
         if self.compress and self._homomorphic:
             # THC-style compressed-domain aggregation: the K-of-N cutoff
             # already happened above, so this is the SINGLE decode point.
-            return self._collect_homomorphic(fresh, dropped)
+            avg, info = self._collect_homomorphic(fresh, dropped)
+            if self.integrity is not None:
+                info["rejected"] = rejected
+            return avg, info
         weights = {}
         acc = None
         wsum = 0.0
@@ -304,6 +332,8 @@ class StaleGradientAggregator:
         avg = [a / wsum for a in acc]
         info = {"used": [sid for _, sid, _, _ in fresh],
                 "dropped_stale": dropped, "weights": weights}
+        if self.integrity is not None:
+            info["rejected"] = rejected
         return jax.tree.unflatten(treedef_out, avg), info
 
     def _collect_homomorphic(self, fresh, dropped) -> Tuple[Any, dict]:

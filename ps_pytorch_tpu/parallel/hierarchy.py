@@ -149,7 +149,7 @@ class GroupAggregator:
 
     def __init__(self, plan: HierarchyPlan, gid: int, codec: str,
                  staleness_limit: int = 4, topk_frac: float = 0.01,
-                 hop_ef: bool = False):
+                 hop_ef: bool = False, integrity: Any = None):
         require_codec("grad_codec", codec, HOMOMORPHIC_GRAD_CODECS)
         self.plan = plan
         self.gid = int(gid)
@@ -158,10 +158,13 @@ class GroupAggregator:
         # No decay at the intra-group tier: members share a fast link, so
         # staleness spread inside a group is noise, not signal. Decay
         # weighting happens once, at the root, from the hop's step meta.
+        # ``integrity`` (a resilience/integrity.py GradIntegrity over the
+        # MEMBER id space) screens member payloads at this hop, before
+        # they enter the group's compressed-domain sum.
         self.inner = StaleGradientAggregator(
             plan.n, staleness_limit=staleness_limit, staleness_decay=0.0,
             num_aggregate=0, compress=True, codec=codec,
-            topk_frac=topk_frac)
+            topk_frac=topk_frac, integrity=integrity)
         self._ef = ErrorFeedback() if hop_ef else None
         self.hops = 0
 
@@ -249,7 +252,7 @@ class RootAggregator:
     def __init__(self, n_groups: int, codec: str, staleness_limit: int = 4,
                  staleness_decay: float = 0.0, num_aggregate: int = 0,
                  on_event: Optional[Callable[[str, int, int, int], None]]
-                 = None):
+                 = None, integrity: Any = None):
         require_codec("grad_codec", codec, HOMOMORPHIC_GRAD_CODECS)
         if n_groups < 1:
             raise ValueError("need at least one group")
@@ -262,6 +265,11 @@ class RootAggregator:
         self.decay = float(staleness_decay)
         self.k = int(num_aggregate)
         self.on_event = on_event
+        # Root-tier screen (a GradIntegrity over the GROUP id space — a
+        # separate strike ledger from the member tier's): a poisoned or
+        # malformed group aggregate is demoted before the root sum, same
+        # contract as the member hop.
+        self.integrity = integrity
         # gid -> (step, wsum, payload leaves, treedef)
         self._pool: Dict[int, Tuple[int, float, List[Any], Any]] = {}
         self._last_step: Dict[int, int] = {}
@@ -326,14 +334,30 @@ class RootAggregator:
                 dropped.append(gid)
                 continue
             fresh.append((staleness, gid, wsum, leaves, treedef))
+        rejected: Dict[int, str] = {}
+        if self.integrity is not None and fresh:
+            # Same discipline as the member tier: screen before the K
+            # cutoff, consume rejects (absent this round; the lifecycle
+            # below sees the silence, not a crash).
+            admitted, rejected = self.integrity.screen(
+                [(gid, leaves) for _, gid, _, leaves, _ in fresh],
+                step=current_step)
+            if rejected:
+                ok = set(admitted)
+                fresh = [t for t in fresh if t[1] in ok]
+                for gid in rejected:
+                    self._pool.pop(gid, None)
         fresh.sort(key=lambda t: (t[0], t[1]))
         if self.k > 0:
             fresh = fresh[:self.k]
         used = [gid for _, gid, _, _, _ in fresh]
         self._update_lifecycle(current_step, used)
         if not fresh:
-            return None, {"used": [], "dropped_stale": dropped,
-                          "weights": {}, "degraded": False}
+            info = {"used": [], "dropped_stale": dropped,
+                    "weights": {}, "degraded": False}
+            if self.integrity is not None:
+                info["rejected"] = rejected
+            return None, info
         with _span("hier_hop", tier=2, step=current_step,
                    groups=len(fresh)) as sargs:
             codec = get_grad_codec(self.codec)
@@ -359,6 +383,8 @@ class RootAggregator:
                 sargs["degraded"] = degraded
         info = {"used": used, "dropped_stale": dropped,
                 "weights": weights, "degraded": degraded}
+        if self.integrity is not None:
+            info["rejected"] = rejected
         tree = (jax.tree.unflatten(treedef_out, avg)
                 if treedef_out is not None else avg)
         return tree, info
@@ -410,7 +436,8 @@ class HierarchicalAggregator:
                  hop_ef: bool = True, intra_every: int = 1,
                  inter_every: int = 1,
                  on_event: Optional[Callable[[str, int, int, int], None]]
-                 = None):
+                 = None, integrity: Any = None,
+                 root_integrity: Any = None):
         self.plan = HierarchyPlan(n_slices, group_size)
         self.codec = codec
         self.topk_frac = float(topk_frac)
@@ -424,15 +451,19 @@ class HierarchicalAggregator:
             n_slices, staleness_limit=staleness_limit, staleness_decay=0.0,
             num_aggregate=0, compress=True, codec=codec,
             topk_frac=topk_frac, error_feedback=error_feedback)
+        # Member ids are globally unique across groups, so ONE member-space
+        # GradIntegrity (strike ledger) is shared by every group hop; the
+        # root gets its own over the group id space.
         self._groups = [GroupAggregator(self.plan, g, codec,
                                         staleness_limit=staleness_limit,
-                                        topk_frac=topk_frac, hop_ef=hop_ef)
+                                        topk_frac=topk_frac, hop_ef=hop_ef,
+                                        integrity=integrity)
                         for g in range(self.plan.n_groups)]
         self.root = RootAggregator(
             self.plan.n_groups, codec, staleness_limit=staleness_limit,
             staleness_decay=staleness_decay,
             num_aggregate=min(int(num_aggregate), self.plan.n_groups),
-            on_event=on_event)
+            on_event=on_event, integrity=root_integrity)
         # gid -> member ids that fed the group's pending root aggregate;
         # replaced on re-submit (latest-wins with the aggregate itself),
         # popped when the root consumes it.
@@ -559,7 +590,8 @@ class HierarchicalKVTransport:
                  bucket_bytes: int = 0, workers: int = 0,
                  hop_retries: int = 3, lease_interval_s: float = 1.0,
                  clock: Optional[Callable[[], float]] = None,
-                 sleep: Optional[Callable[[float], None]] = None):
+                 sleep: Optional[Callable[[float], None]] = None,
+                 integrity: Any = None):
         from ps_pytorch_tpu.elastic.election import group_election
         from ps_pytorch_tpu.parallel.transport import KVPytreeChannel
         from ps_pytorch_tpu.resilience.retry import RetryPolicy
@@ -587,10 +619,14 @@ class HierarchicalKVTransport:
                                       param_template, **self._chan_kw)
         self._param_version = -1
         self._last_agg_seen: Dict[int, int] = {}
-        # Tier-1 pooling runs wherever the aggregator role lands.
+        # Tier-1 pooling runs wherever the aggregator role lands; the
+        # member-space integrity screen (when attached) rides inside it,
+        # so a poisoned member is rejected at ITS group hop, one DCN hop
+        # from the source.
         self._pool = GroupAggregator(self.plan, self.gid, codec,
                                      staleness_limit=staleness_limit,
-                                     topk_frac=topk_frac, hop_ef=False)
+                                     topk_frac=topk_frac, hop_ef=False,
+                                     integrity=integrity)
         self.election = group_election(
             kv, run_id, self.gid, self.pid, self.n,
             preferred=self.plan.aggregator_of(self.gid),
@@ -798,6 +834,8 @@ class HierarchicalKVTransport:
             "wire_publishes": sum(c.publishes for c in chans),
             "wire_read_errors": sum(c.read_errors for c in chans),
             "wire_publish_errors": sum(c.publish_errors for c in chans),
+            "wire_integrity_failures": sum(c.integrity_failures
+                                           for c in chans),
             "hier_hops": self.stats["hops"],
             "hier_failovers": self.stats["failovers"],
             "hier_hop_giveups": self.stats["hop_giveups"],

@@ -39,6 +39,15 @@ and new readers use to fetch/decode buckets concurrently. The ver pointer
 still moves only after EVERY bucket has committed, so race-free ordering
 and the once-only fault semantics from resilience/ are unchanged.
 ``bucket_bytes == 0`` takes the legacy single-payload code path untouched.
+
+Wire integrity (layer 1 of resilience/integrity.py): every chunk a channel
+publishes carries a CRC token in the version meta (``"crc"``: per-leaf
+lists aligned with ``"chunks"``), and readers verify each chunk before
+decode. A mismatch — or a decode error from corrupted armour, or torn meta
+JSON — demotes the whole read to None ("absent this round", exactly like a
+concurrent GC) and counts in ``integrity_failures``; it NEVER crashes the
+reader, because the K-of-N / staleness machinery upstream already absorbs
+absence. Metas without ``"crc"`` (older writers) read fine unverified.
 """
 
 import json
@@ -54,9 +63,10 @@ from ps_pytorch_tpu.compression.codecs import (
 from ps_pytorch_tpu.parallel.buckets import (
     bucket_counts, leaf_nbytes, plan_buckets, stream_buckets,
 )
+from ps_pytorch_tpu.resilience.integrity import verify_digest, wire_digest
 from ps_pytorch_tpu.resilience.retry import is_retryable
 from ps_pytorch_tpu.telemetry.trace import span as _span
-from ps_pytorch_tpu.utils.armor import b85decode, b85encode
+from ps_pytorch_tpu.utils.armor import WireCorrupt, b85decode, b85encode
 
 _CHUNK = 1 << 18  # 256 KiB of base85 text per KV value (what bytes_out counts)
 
@@ -113,6 +123,7 @@ class KVPytreeChannel:
         self.publishes = 0
         self.read_errors = 0        # transient read failures tolerated
         self.publish_errors = 0     # transient publish failures absorbed
+        self.integrity_failures = 0  # digest/decode/meta corruption demotions
         self._pool: Optional[ThreadPoolExecutor] = None
 
     def _executor(self) -> ThreadPoolExecutor:
@@ -173,10 +184,12 @@ class KVPytreeChannel:
         """Legacy blocking wire: leaf-at-a-time encode+put, byte-exact with
         every payload this channel ever produced before bucketing existed."""
         chunk_counts = []
+        crc: List[List[str]] = []
         nbytes = raw_bytes = 0
         for l_idx, leaf in enumerate(leaves):
             chunks = _encode_leaf(leaf, self.level, self.codec)
             chunk_counts.append(len(chunks))
+            crc.append([wire_digest(c) for c in chunks])
             nbytes += sum(len(c) for c in chunks)
             raw_bytes += leaf_nbytes(leaf)
             for c_idx, c in enumerate(chunks):
@@ -186,7 +199,7 @@ class KVPytreeChannel:
         self.last_publish_bytes = nbytes
         self.last_publish_raw_bytes = raw_bytes
         self.last_publish_bucket_bytes = [nbytes]
-        return chunk_counts, {}
+        return chunk_counts, {"crc": crc}
 
     def _put_bucketed(self, version: int, leaves: List[Any]):
         """Overlapped wire: per-bucket sync → pooled encode+put. Same chunk
@@ -210,18 +223,20 @@ class KVPytreeChannel:
                     for c_idx, c in enumerate(chunks):
                         self.kv.set(f"{self.prefix}/{version}/{l_idx}/{c_idx}",
                                     c)
-            return [len(chunks) for chunks in texts], nbytes, b.nbytes
+            crc = [[wire_digest(c) for c in chunks] for chunks in texts]
+            return [len(chunks) for chunks in texts], nbytes, b.nbytes, crc
 
         results = stream_buckets(leaves, bks, encode_put, pool)
-        chunk_counts = [n for counts, _, _ in results for n in counts]
-        per_bucket = [nb for _, nb, _ in results]
-        raw_bytes = sum(rb for _, _, rb in results)
+        chunk_counts = [n for counts, _, _, _ in results for n in counts]
+        crc = [d for _, _, _, digests in results for d in digests]
+        per_bucket = [nb for _, nb, _, _ in results]
+        raw_bytes = sum(rb for _, _, rb, _ in results)
         self.bytes_out += sum(per_bucket)
         self.bytes_raw_out += raw_bytes
         self.last_publish_bytes = sum(per_bucket)
         self.last_publish_raw_bytes = raw_bytes
         self.last_publish_bucket_bytes = per_bucket
-        return chunk_counts, {"buckets": bucket_counts(bks)}
+        return chunk_counts, {"buckets": bucket_counts(bks), "crc": crc}
 
     def _gc(self, version: int) -> None:
         if version < 0:
@@ -281,19 +296,53 @@ class KVPytreeChannel:
         meta_s = self.kv.get(f"{self.prefix}/{version}/meta")
         if meta_s is None:
             return None
-        meta = json.loads(meta_s)
-        counts = meta["chunks"]
+        try:
+            meta = json.loads(meta_s)
+            counts = meta["chunks"]
+        except (ValueError, TypeError, KeyError):
+            # Torn/corrupted meta demotes like a failed digest: absent this
+            # round, counted, never a reader crash.
+            self.integrity_failures += 1
+            return None
+        crc = meta.get("crc")
         bucket_leaf_counts = meta.get("buckets")
         if (self.workers > 1 and bucket_leaf_counts is not None
                 and len(bucket_leaf_counts) > 1):
-            leaves = self._fetch_bucketed(version, counts, bucket_leaf_counts)
+            leaves = self._fetch_bucketed(version, counts, bucket_leaf_counts,
+                                          crc)
         else:
-            leaves = self._fetch_serial(version, counts)
+            leaves = self._fetch_serial(version, counts, crc)
         if leaves is None:
             return None
         return version, jax.tree.unflatten(self.treedef, leaves), meta
 
-    def _fetch_serial(self, version: int, counts: List[int]):
+    def _checked_decode(self, l_idx: int, chunks: List[str],
+                        crc: Optional[List[List[str]]]):
+        """Digest-verify + decode one leaf's chunks; None on any integrity
+        failure (counted). ``crc`` is the meta's per-leaf token table —
+        None for pre-digest writers, which decode unverified (decode errors
+        still demote rather than crash)."""
+        if crc is not None:
+            try:
+                tokens = crc[l_idx]
+                ok = (len(tokens) == len(chunks) and
+                      all(verify_digest(c, t)
+                          for c, t in zip(chunks, tokens)))
+            except (TypeError, IndexError):
+                ok = False              # corrupted token table
+            if not ok:
+                self.integrity_failures += 1
+                return None
+        try:
+            return _decode_leaf(chunks)
+        except (WireCorrupt, ValueError):
+            # Corrupted armour/framing on a chunk the digest could not vouch
+            # for (legacy meta) — same demotion, never a crash.
+            self.integrity_failures += 1
+            return None
+
+    def _fetch_serial(self, version: int, counts: List[int],
+                      crc: Optional[List[List[str]]] = None):
         leaves = []
         for l_idx, n in enumerate(counts):
             chunks = [self.kv.get(f"{self.prefix}/{version}/{l_idx}/{c_idx}")
@@ -301,11 +350,15 @@ class KVPytreeChannel:
             if any(c is None for c in chunks):
                 return None  # concurrently GC'd (reader was very stale)
             self.bytes_in += sum(len(c) for c in chunks)
-            leaves.append(_decode_leaf(chunks))
+            leaf = self._checked_decode(l_idx, chunks, crc)
+            if leaf is None:
+                return None
+            leaves.append(leaf)
         return leaves
 
     def _fetch_bucketed(self, version: int, counts: List[int],
-                        bucket_leaf_counts: List[int]):
+                        bucket_leaf_counts: List[int],
+                        crc: Optional[List[List[str]]] = None):
         """Concurrent per-bucket get+decode along the writer's bucket plan
         (shipped in meta): bucket k decodes while bucket k+1's chunks are
         still in flight. Any missing chunk (concurrent GC) voids the read,
@@ -324,7 +377,10 @@ class KVPytreeChannel:
                     if any(c is None for c in chunks):
                         return None
                     nbytes += sum(len(c) for c in chunks)
-                    leaves.append(_decode_leaf(chunks))
+                    leaf = self._checked_decode(l_idx, chunks, crc)
+                    if leaf is None:
+                        return None
+                    leaves.append(leaf)
                 return leaves, nbytes
 
         futures, start = [], 0
@@ -404,6 +460,8 @@ class KVGradientTransport:
             "param_publishes": self.param_ch.publishes,
             "last_param_publish_bytes": self.param_ch.last_publish_bytes,
             "wire_read_errors": sum(c.read_errors for c in chans),
+            "wire_integrity_failures": sum(c.integrity_failures
+                                           for c in chans),
         }
 
     # ---- run control ----

@@ -70,6 +70,29 @@ Spec grammar (``;``-separated faults, each ``kind:key=val,key=val``):
         while a bare ``prefix=hagg`` matches no key at all. A scoped
         prefix models one slow link without touching the others — the
         WAN-edge half of the multi-hop failure model.
+    payload_bitflip:p=0.05,seed=9[,prefix=async-42/agrad]
+        Reader-side wire corruption: a KV ``get`` returning a payload
+        CHUNK (a key whose last two path components are both numeric) has
+        one character replaced with a DIFFERENT base85-alphabet character
+        with probability ``p``. The armour still decodes cleanly, so only
+        the layer-1 wire digest (resilience/integrity.py) can catch it —
+        which is the point of the fault. ``prefix`` scopes to one link's
+        keys, same as link_jitter.
+    payload_truncate:p=0.02,seed=4[,prefix=...]
+        Reader-side torn read: the returned chunk is cut to its first
+        half. Depending on framing this surfaces as a digest mismatch or
+        an armor ``WireCorrupt``/short-buffer decode error; either way the
+        reader must demote the read ("absent this round"), never crash.
+    grad_poison:scale=1000,r=2[,step=0][,steps=0]
+        Process ``r`` multiplies its LOCAL gradients by ``scale`` before
+        encode for every step in [step, step+steps) (steps=0: to end of
+        run) — a persistently sick replica. The values stay finite and
+        the wire is honest, so only the leader's pre-sum outlier screen
+        (resilience/integrity.py MAD gate) can catch it; the quarantine
+        drill (tools/poison_drill.py) asserts that it does, that the
+        offender is quarantined, and that the healed replica is
+        readmitted once the window closes. The trainer reads the window
+        via ``poison_scale(step)``.
 
 Drop/delay decisions come from ``numpy.default_rng(seed + 10007 * pid)``:
 reproducible per process, uncorrelated across processes.
@@ -81,8 +104,26 @@ from typing import Any, Callable, Dict, List, Optional
 import numpy as np
 
 _KINDS = ("kv_drop", "kv_delay", "replica_crash", "ckpt_corrupt", "grad_nan",
-          "leader_kill", "kv_partition", "link_jitter", "replica_kill")
+          "leader_kill", "kv_partition", "link_jitter", "replica_kill",
+          "payload_bitflip", "payload_truncate", "grad_poison")
 _KV_OPS = ("set", "get", "delete")
+# The kinds FaultyKV enforces (everything else fires from the step /
+# checkpoint / serving planes).
+_KV_FAULT_KINDS = ("kv_drop", "kv_delay", "kv_partition", "link_jitter",
+                   "payload_bitflip", "payload_truncate")
+# base64's b85 alphabet (spelled out; resilience/ stays a leaf): bitflips
+# substitute IN-alphabet so the armour still decodes and only the wire
+# digest can tell.
+_B85_CHARS = ("0123456789ABCDEFGHIJKLMNOPQRSTUVWXYZ"
+              "abcdefghijklmnopqrstuvwxyz!#$%&()*+-;<=>?@^_`{|}~")
+
+
+def _is_chunk_key(key: str) -> bool:
+    """Payload chunk keys — and only they — end in two numeric path
+    components (``<prefix>/<version>/<leaf>/<chunk>``, transport.py wire
+    discipline). Meta/pointer/heartbeat keys never match."""
+    parts = key.rsplit("/", 2)
+    return (len(parts) == 3 and parts[1].isdigit() and parts[2].isdigit())
 
 
 class TransientKVError(ConnectionError):
@@ -223,6 +264,24 @@ def _validate(p: Dict[str, Any], part: str) -> None:
         else:
             raise ValueError(f"kv_partition r must be an int or "
                              f"'+'-separated ints (got {part!r})")
+    elif kind in ("payload_bitflip", "payload_truncate"):
+        prob = p.get("p")
+        if not isinstance(prob, (int, float)) or not 0 <= prob <= 1:
+            raise ValueError(f"{kind} needs p in [0,1] (got {part!r})")
+        if "prefix" in p and not isinstance(p["prefix"], str):
+            raise ValueError(f"{kind} prefix must be a string "
+                             f"(got {part!r})")
+    elif kind == "grad_poison":
+        if not isinstance(p.get("scale"), (int, float)) or p["scale"] == 0:
+            raise ValueError(f"grad_poison needs scale=<nonzero number> "
+                             f"(got {part!r})")
+        p.setdefault("r", 0)
+        if not isinstance(p.setdefault("step", 0), int) or p["step"] < 0:
+            raise ValueError(f"grad_poison step must be an int >= 0 "
+                             f"(got {part!r})")
+        if not isinstance(p.setdefault("steps", 0), int) or p["steps"] < 0:
+            raise ValueError(f"grad_poison steps must be an int >= 0 "
+                             f"(0 = to end of run) (got {part!r})")
     elif kind == "link_jitter":
         s = p.get("s")
         if not isinstance(s, (int, float)) or s <= 0:
@@ -279,6 +338,8 @@ class FaultyKV:
                         f"UNAVAILABLE: injected kv_partition on {op} "
                         f"(step {self._inj.current_step})")
                 continue
+            if f["kind"] in ("payload_bitflip", "payload_truncate"):
+                continue                # applied to get RESULTS, not ops
             if f.get("op") is not None and f["op"] != op:
                 continue
             if f["kind"] == "link_jitter":
@@ -307,7 +368,36 @@ class FaultyKV:
 
     def get(self, key: str, default: Optional[str] = None) -> Optional[str]:
         self._roll("get", key)
-        return self.inner.get(key, default)
+        return self._maybe_corrupt(key, self.inner.get(key, default))
+
+    def _maybe_corrupt(self, key: str, val):
+        """Reader-side payload corruption (payload_bitflip /
+        payload_truncate): mutates the RETURNED chunk text, never the
+        store — exactly what a flaky NIC or torn read does. Only
+        chunk-shaped keys are eligible, so pointers/meta/heartbeats stay
+        honest and the blast radius is precisely the integrity layer's
+        jurisdiction."""
+        if not isinstance(val, str) or not val or not _is_chunk_key(key):
+            return val
+        for f, rng in zip(self._faults, self._rngs):
+            kind = f["kind"]
+            if kind not in ("payload_bitflip", "payload_truncate"):
+                continue
+            if f.get("prefix") and not key.startswith(f["prefix"]):
+                continue
+            if rng.random() >= f["p"]:
+                continue
+            if kind == "payload_bitflip":
+                pos = int(rng.integers(len(val)))
+                repl = old = val[pos]
+                while repl == old:
+                    repl = _B85_CHARS[int(rng.integers(len(_B85_CHARS)))]
+                val = val[:pos] + repl + val[pos + 1:]
+                self._inj.counters["payload_bitflips"] += 1
+            else:
+                val = val[:max(1, len(val) // 2)]
+                self._inj.counters["payload_truncates"] += 1
+        return val
 
     def delete(self, key: str) -> None:
         self._roll("delete", key)
@@ -337,19 +427,17 @@ class FaultInjector:
         self.counters: Dict[str, int] = {
             "kv_drops": 0, "kv_delays": 0, "crashes": 0,
             "ckpt_corruptions": 0, "grad_nans": 0, "leader_kills": 0,
-            "kv_partition_drops": 0, "link_jitters": 0, "replica_kills": 0}
+            "kv_partition_drops": 0, "link_jitters": 0, "replica_kills": 0,
+            "payload_bitflips": 0, "payload_truncates": 0, "grad_poisons": 0}
 
     # ---- KV plane ----
     @property
     def has_kv_faults(self) -> bool:
-        return any(f["kind"] in ("kv_drop", "kv_delay", "kv_partition",
-                                 "link_jitter")
-                   for f in self.faults)
+        return any(f["kind"] in _KV_FAULT_KINDS for f in self.faults)
 
     def wrap_kv(self, kv):
         kv_faults = [f for f in self.faults
-                     if f["kind"] in ("kv_drop", "kv_delay", "kv_partition",
-                                      "link_jitter")]
+                     if f["kind"] in _KV_FAULT_KINDS]
         if not kv_faults:
             return kv
         return FaultyKV(kv, kv_faults, self, self.sleep)
@@ -425,6 +513,23 @@ class FaultInjector:
                 self.counters["grad_nans"] += 1
                 return True
         return False
+
+    def poison_scale(self, step: int) -> Optional[float]:
+        """The grad_poison multiplier when a window is open for this
+        process at ``step``, else None. NOT once-only: the window
+        [step, step+steps) (steps=0: to end of run) stays open every
+        step, so the quarantine sees a REPEAT offender, and closes on
+        schedule so readmission-after-heal is observable."""
+        for f in self.faults:
+            if f["kind"] != "grad_poison" or f["r"] != self.process_index:
+                continue
+            if step < f["step"]:
+                continue
+            if f["steps"] > 0 and step >= f["step"] + f["steps"]:
+                continue
+            self.counters["grad_poisons"] += 1
+            return float(f["scale"])
+        return None
 
     # ---- checkpoint plane ----
     def after_checkpoint(self, train_dir: str, step: int) -> None:

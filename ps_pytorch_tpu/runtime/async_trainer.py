@@ -45,7 +45,8 @@ from ps_pytorch_tpu.runtime.metrics import MetricsLogger
 from ps_pytorch_tpu.runtime.multislice import make_slice_grad_fn
 from ps_pytorch_tpu.telemetry import (
     MetricsExporter, Registry, Tracer, declare_elastic_metrics,
-    declare_hierarchy_metrics, declare_resilience_metrics,
+    declare_hierarchy_metrics, declare_integrity_metrics,
+    declare_resilience_metrics,
     declare_training_metrics, device_memory_record, host_rss_bytes,
     set_default_tracer,
 )
@@ -179,6 +180,14 @@ class AsyncTrainer:
         wire_bucket_bytes = int(cfg.wire_bucket_mb * (1 << 20))
         self._wire_overlap = wire_bucket_bytes > 0
         self._hier = cfg.sync_topology == "hier"
+        # Gradient integrity (--grad-integrity, resilience/integrity.py):
+        # the leader-side ledger screens pooled contributions before the
+        # sum; in hier mode a second member-space ledger rides the group
+        # hop (whichever process holds the group lease screens its
+        # members). Wire digests (layer 1) need no ledger — transport.py
+        # stamps/verifies crc32 per chunk unconditionally.
+        self._integrity = None        # leader ledger over contributor ids
+        self._group_integrity = None  # member-space ledger (hier group hop)
         if self._hier:
             # 2-tier multi-hop sync (parallel/hierarchy.py): members
             # publish to key-namespaced intra-group channels, the group
@@ -189,6 +198,7 @@ class AsyncTrainer:
             from ps_pytorch_tpu.parallel.hierarchy import (
                 HierarchicalKVTransport,
             )
+            self._group_integrity = self._make_integrity()
             self.transport = HierarchicalKVTransport(
                 kv, self.n, grad_template=grad_template,
                 param_template=param_template, run_id=f"async-{cfg.seed}",
@@ -197,7 +207,8 @@ class AsyncTrainer:
                 topk_frac=cfg.grad_topk_frac, chan_codec=chan_codec,
                 level=cfg.codec_level, bucket_bytes=wire_bucket_bytes,
                 workers=cfg.wire_workers, hop_retries=cfg.hier_hop_retries,
-                lease_interval_s=cfg.leader_lease_s or 1.0)
+                lease_interval_s=cfg.leader_lease_s or 1.0,
+                integrity=self._group_integrity)
             print(f"HIER topology pid {self.pid}: "
                   f"{self.transport.describe()}", flush=True)
         else:
@@ -248,6 +259,9 @@ class AsyncTrainer:
         if self.injector is not None or self._retrier is not None:
             declare_resilience_metrics(self.registry)
             collect.append(self._pump_resilience_metrics)
+        if cfg.grad_integrity:
+            declare_integrity_metrics(self.registry)
+            collect.append(self._pump_integrity_metrics)
         self.exporter = None
         if cfg.metrics_port > 0:
             self.exporter = MetricsExporter(
@@ -271,8 +285,24 @@ class AsyncTrainer:
                 lambda p, o, g: apply_optimizer(self.tx, p, o, g),
                 out_shardings=(rep, rep))
 
+    def _make_integrity(self):
+        """One screening ledger (--grad-integrity): compressed-domain
+        validation + MAD outlier gate + strike/quarantine bookkeeping.
+        Built per contributor-id space — leader pool and hier group hop
+        get SEPARATE instances (slice ids vs group ids)."""
+        cfg = self.cfg
+        if not cfg.grad_integrity:
+            return None
+        from ps_pytorch_tpu.resilience.integrity import GradIntegrity
+        return GradIntegrity(
+            mad_threshold=cfg.integrity_mad_threshold,
+            strike_limit=cfg.integrity_strike_limit,
+            readmit_clean=cfg.integrity_readmit_clean,
+            on_event=self._integrity_event)
+
     def _make_leader_aggregator(self):
         cfg = self.cfg
+        self._integrity = self._make_integrity()
         if self._hier:
             # Root tier pools GROUP aggregates; K-of-N applies per tier,
             # so the member-count knob is clamped to the group count.
@@ -283,7 +313,7 @@ class AsyncTrainer:
                 staleness_limit=cfg.staleness_limit,
                 staleness_decay=cfg.staleness_decay,
                 num_aggregate=min(cfg.num_aggregate, plan.n_groups),
-                on_event=self._hier_event)
+                on_event=self._hier_event, integrity=self._integrity)
         if self._wire_homo:
             # Homomorphic wire: the pool holds PAYLOADS (submit_encoded)
             # and collect() sums them in the compressed domain. EF stays
@@ -292,12 +322,14 @@ class AsyncTrainer:
                 self.n, staleness_limit=cfg.staleness_limit,
                 staleness_decay=cfg.staleness_decay,
                 num_aggregate=cfg.num_aggregate, compress=True,
-                codec=cfg.grad_codec, topk_frac=cfg.grad_topk_frac)
+                codec=cfg.grad_codec, topk_frac=cfg.grad_topk_frac,
+                integrity=self._integrity)
         return StaleGradientAggregator(
             self.n, staleness_limit=cfg.staleness_limit,
             staleness_decay=cfg.staleness_decay,
             num_aggregate=cfg.num_aggregate,
-            compress=False)  # the WIRE is compressed; the pool is local
+            compress=False,  # the WIRE is compressed; the pool is local
+            integrity=self._integrity)
 
     def _pump_resilience_metrics(self) -> None:
         """Refresh resilience counters from the live fault/retry snapshots
@@ -314,6 +346,46 @@ class AsyncTrainer:
                 delta = value - self.registry.get(name)
             except KeyError:
                 continue            # snapshot key with no declared metric
+            if delta > 0:
+                self.registry.inc(name, delta)
+
+    def _integrity_event(self, kind: str, cid: int, step: int,
+                         detail: str) -> None:
+        """Quarantine lifecycle callback: one parseable line per
+        transition (tools/poison_drill.py greps these). Per-payload
+        strikes stay silent — the counters carry them."""
+        if kind == "quarantine":
+            print(f"INTEGRITY quarantine contributor {cid} at version "
+                  f"{step} ({detail})", flush=True)
+        elif kind == "readmit":
+            print(f"INTEGRITY readmit contributor {cid} at version {step}",
+                  flush=True)
+
+    def _integrity_snapshot(self) -> dict:
+        """Merged counters over every ledger this process runs (leader
+        pool + hier group hop) plus the transport's wire-digest
+        failures."""
+        snap: dict = {}
+        for ledger in (self._integrity, self._group_integrity):
+            if ledger is None:
+                continue
+            for k, v in ledger.snapshot().items():
+                snap[k] = snap.get(k, 0) + v
+        snap["wire_integrity_failures"] = self.transport.wire_stats()[
+            "wire_integrity_failures"]
+        return snap
+
+    def _pump_integrity_metrics(self) -> None:
+        """Refresh integrity_* registry metrics from the live ledger
+        snapshots (same delta-inc discipline as the resilience pump)."""
+        snap = self._integrity_snapshot()
+        self.registry.set("integrity_quarantined",
+                          float(snap.pop("integrity_quarantined", 0)))
+        for name, value in snap.items():
+            try:
+                delta = value - self.registry.get(name)
+            except KeyError:
+                continue
             if delta > 0:
                 self.registry.inc(name, delta)
 
@@ -581,6 +653,14 @@ class AsyncTrainer:
                                    + self._seq * 13 + self.pid))
         self._bs = new_bs
         self._seq += 1
+        if self.injector is not None:
+            # Poisoned-contributor drill (--fault-spec grad_poison): the
+            # fault scales this process's OWN gradients before encode, so
+            # the corruption rides the real wire and the leader's screen
+            # must catch it downstream.
+            scale = self.injector.poison_scale(self._seq)
+            if scale is not None:
+                grads = jax.tree.map(lambda g: g * scale, grads)
         self.transport.submit_grads(self.pid, self._seq, version_used,
                                     self._encode_grads(grads))
         with self.tracer.span("device_sync", step=self._seq):
@@ -681,6 +761,20 @@ class AsyncTrainer:
                              f"{root['degraded_steps']} groups_healthy "
                              f"{root['groups_healthy']}")
                 print(line, flush=True)
+            if self._integrity is not None or \
+                    self._group_integrity is not None:
+                # One parseable integrity summary per screening process —
+                # tools/poison_drill.py reads its quarantine/readmission/
+                # wire-failure evidence from here.
+                s = self._integrity_snapshot()
+                print(f"INTEGRITY pid {self.pid} screen_rejects "
+                      f"{s.get('integrity_screen_rejects', 0)} "
+                      f"outlier_rejects "
+                      f"{s.get('integrity_outlier_rejects', 0)} strikes "
+                      f"{s.get('integrity_strikes', 0)} quarantines "
+                      f"{s.get('integrity_quarantines', 0)} readmissions "
+                      f"{s.get('integrity_readmissions', 0)} wire_failures "
+                      f"{s.get('wire_integrity_failures', 0)}", flush=True)
         finally:
             if self.announcer is not None:
                 try:
@@ -774,6 +868,13 @@ class AsyncTrainer:
                     extra["leader_epoch"] = self.election.epoch
                 if self._hier:
                     extra.update(self._hier_telemetry())
+                if self._integrity is not None or \
+                        self._group_integrity is not None:
+                    isnap = self._integrity_snapshot()
+                    # Schema gate: vanilla runs only grow integrity
+                    # columns once a screen/digest actually fired.
+                    if self.injector is not None or any(isnap.values()):
+                        extra.update(isnap)
                 if self.injector is not None:
                     extra.update(self.injector.snapshot())
                 if self._retrier is not None:

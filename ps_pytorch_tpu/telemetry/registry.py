@@ -204,6 +204,11 @@ RESILIENCE_COUNTERS = (
     ("kv_partition_drops", "ops",
      "KV ops dropped inside an injected partition window"),
     ("link_jitters", "ops", "injected per-link KV delays applied"),
+    ("payload_bitflips", "ops",
+     "injected in-alphabet chunk corruptions on KV reads"),
+    ("payload_truncates", "ops", "injected torn-read chunk truncations"),
+    ("grad_poisons", "steps",
+     "steps where an injected grad_poison window scaled local gradients"),
 )
 
 
@@ -211,6 +216,44 @@ def declare_resilience_metrics(registry: Registry) -> Registry:
     """Declare every resilience counter on ``registry`` (all monotonic)."""
     for name, unit, help_ in RESILIENCE_COUNTERS:
         registry.counter(name, unit=unit, help=help_)
+    return registry
+
+
+# ---- gradient-integrity contract (ps_pytorch_tpu/resilience/integrity.py) --
+#
+# Same discipline: the reviewable surface of the three integrity layers.
+# wire_integrity_failures comes from the transport channels (digest/decode/
+# meta demotions); the rest from the leader-side GradIntegrity screen.
+# Counters are cumulative (Prometheus renders them with _total — the drill
+# gates on integrity_quarantines_total); quarantined-now is a gauge.
+INTEGRITY_COUNTERS = (
+    ("wire_integrity_failures", "reads",
+     "channel reads demoted for digest mismatch / corrupt armour / torn "
+     "meta"),
+    ("integrity_screen_rejects", "contributions",
+     "contributions rejected by the compressed-domain payload validators"),
+    ("integrity_outlier_rejects", "contributions",
+     "contributions rejected by the cross-contributor MAD outlier gate"),
+    ("integrity_strikes", "events",
+     "screened-out contributions charged to a contributor"),
+    ("integrity_quarantines", "events",
+     "contributors quarantined after reaching the strike limit"),
+    ("integrity_readmissions", "events",
+     "quarantined contributors readmitted on probation after clean "
+     "screens"),
+)
+INTEGRITY_GAUGES = (
+    ("integrity_quarantined", "contributors",
+     "contributors currently quarantined"),
+)
+
+
+def declare_integrity_metrics(registry: Registry) -> Registry:
+    """Declare the gradient-integrity counters/gauge on ``registry``."""
+    for name, unit, help_ in INTEGRITY_COUNTERS:
+        registry.counter(name, unit=unit, help=help_)
+    for name, unit, help_ in INTEGRITY_GAUGES:
+        registry.gauge(name, unit=unit, help=help_)
     return registry
 
 

@@ -36,6 +36,16 @@ Families and their watched metrics (direction, relative tolerance):
                                         recorded a speedup > 1 (kv_giveups
                                         are EXPECTED — a partition makes
                                         the retry plane give up by design)
+- ``integrity``  RESILIENCE_r*.json     newest artifact WITH an "integrity"
+                                        section: the poisoned-contributor
+                                        drill (tools/poison_drill.py) saw
+                                        >=1 quarantine, >=1 probation
+                                        readmission and >=1 wire digest
+                                        failure, zero crashes, the
+                                        screen-off control diverged, the
+                                        screened run's final loss matched
+                                        the clean baseline, and the digest+
+                                        screen overhead stayed < 2%
 
 Rows are matched by their "config" name — a config present in the baseline
 but missing from the candidate is a failure (silently dropping a bench row
@@ -151,6 +161,23 @@ FAMILIES: Dict[str, dict] = {
         "metrics": [],              # invariant check, see _check_router
         "bools": ["bitwise_equal", "ok"],
     },
+    "integrity": {
+        # Same artifact series, gating the gradient-integrity drill
+        # (tools/poison_drill.py): the newest RESILIENCE_r*.json carrying
+        # an "integrity" section must show the poisoned contributor was
+        # actually quarantined and later readmitted on probation, the wire
+        # digests caught >=1 bit-flipped chunk, nobody crashed (every
+        # reject demotes to "absent this round"), the no-screen control
+        # diverged (proof the screen is load-bearing), and the per-step
+        # digest+screen cost stayed under the 2% budget.
+        "pattern": "RESILIENCE_r[0-9]*.json",
+        "metrics": [],              # invariant check, see _check_integrity
+        "bools": ["bitwise_equal", "ok"],
+        "min_integrity": [("quarantines", 1), ("readmissions", 1),
+                          ("screen_rejects", 3),
+                          ("wire_integrity_failures", 1)],
+        "absolute": [("overhead_frac", 0.02)],
+    },
 }
 
 
@@ -214,6 +241,8 @@ def compare(family: str, baseline, candidate) -> dict:
         return _check_hierarchy(spec, candidate)
     if family == "router":
         return _check_router(spec, candidate)
+    if family == "integrity":
+        return _check_integrity(spec, candidate)
     if family == "ops":
         return _check_ops(spec, candidate)
     if family == "slo":
@@ -473,6 +502,43 @@ def _check_router(spec: dict, candidate) -> dict:
             "configs": {"invariants": {"ok": ok, "metrics": checks}}}
 
 
+def _check_integrity(spec: dict, candidate) -> dict:
+    doc = candidate if isinstance(candidate, dict) else \
+        (candidate[0] if candidate else {})
+    checks: Dict[str, dict] = {}
+    ok = True
+    integ = doc.get("integrity")
+    if not isinstance(integ, dict):
+        return {"family": "integrity", "ok": False,
+                "configs": {"invariants": {"ok": False, "metrics": {
+                    "_integrity": {"ok": False,
+                                   "note": "artifact has no integrity "
+                                           "section"}}}}}
+    for key in spec["bools"]:
+        if key in doc:
+            checks[key] = {"cand": doc[key], "ok": bool(doc[key])}
+            ok = ok and checks[key]["ok"]
+    for key, floor in spec["min_integrity"]:
+        val = int(integ.get(key, 0))
+        checks[key] = {"cand": val, "floor": floor, "ok": val >= floor}
+        ok = ok and checks[key]["ok"]
+    # Every digest failure / screen reject demotes; nobody may crash.
+    crashes = int(integ.get("crashes", -1))
+    checks["crashes"] = {"cand": crashes, "ok": crashes == 0}
+    ok = ok and checks["crashes"]["ok"]
+    # The no-screen control run must diverge — otherwise the drill's
+    # poison was too weak to prove the screen did anything.
+    diverged = bool(integ.get("control_diverged", False))
+    checks["control_diverged"] = {"cand": diverged, "ok": diverged}
+    ok = ok and checks["control_diverged"]["ok"]
+    for metric, budget in spec["absolute"]:
+        val = float(integ.get(metric, float("inf")))
+        checks[metric] = {"cand": val, "budget": budget, "ok": val < budget}
+        ok = ok and checks[metric]["ok"]
+    return {"family": "integrity", "ok": ok,
+            "configs": {"invariants": {"ok": ok, "metrics": checks}}}
+
+
 def run_gate(family: str, candidate_path: str, repo: str = ".",
              baseline_path: str = "") -> dict:
     """Gate one candidate artifact against the newest committed baseline
@@ -482,7 +548,7 @@ def run_gate(family: str, candidate_path: str, repo: str = ".",
     candidate = load_artifact(candidate_path)
     baseline = None
     if family not in ("resilience", "ops", "slo", "wire_codec",
-                      "hierarchy", "router"):
+                      "hierarchy", "router", "integrity"):
         if baseline_path:
             baseline = load_artifact(baseline_path)
         else:
@@ -513,7 +579,7 @@ def run_all(repo: str = ".") -> dict:
             families[family] = {"family": family, "ok": True,
                                 "note": "no committed artifacts; skipped"}
             continue
-        if family in ("elastic", "hierarchy", "router"):
+        if family in ("elastic", "hierarchy", "router", "integrity"):
             # Gate the newest artifact that actually ran this drill
             # (older RESILIENCE rounds predate the subsystem).
             with_section = [p for p in paths if isinstance(

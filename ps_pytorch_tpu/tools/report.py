@@ -37,6 +37,19 @@ def _newest(pattern: str, repo: str, exclude: str = ""):
     return paths[-1] if paths else None
 
 
+def _newest_with_section(pattern: str, repo: str, section: str):
+    """Newest-round artifact carrying a given top-level section — drill
+    families share the RESILIENCE_r*.json series, so the newest round of
+    ONE family is usually not the newest file overall."""
+    paths = sorted(glob.glob(os.path.join(repo, pattern)),
+                   key=lambda p: (_round_of(p), p))
+    for p in reversed(paths):
+        d = _load(p)
+        if isinstance(d, dict) and isinstance(d.get(section), dict):
+            return p
+    return None
+
+
 def _load(path: str):
     """Parse a whole-JSON or JSON-lines artifact.
 
@@ -240,24 +253,43 @@ def collect(repo: str):
             "crashes": c.get("crashes"),
             "kv_retries": c.get("kv_retries"),
             "ok": d.get("ok") is True and "_parse_error" not in d})
-        router = d.get("router")
-        if isinstance(router, dict):
-            # Fleet-serving evidence (tools/router_drill.py): SIGKILL
-            # under Poisson load absorbed by failover, rolling reload with
-            # zero failed requests, hedging beating no-hedge p99.
-            kill = router.get("kill") or {}
-            hedge = router.get("hedge") or {}
-            reload_ = router.get("reload") or {}
-            add("fleet serving", p, {
-                "value": kill.get("availability"),
-                "unit": "availability under replica SIGKILL",
-                "platform": d.get("platform"),
-                "replicas": router.get("replicas"),
-                "hedge_p99_ratio": hedge.get("p99_ratio"),
-                "ok": (d.get("ok") is True
-                       and int(kill.get("failed_5xx", -1)) == 0
-                       and int(reload_.get("failed_5xx", -1)) == 0
-                       and bool(reload_.get("model_step_advanced")))})
+    p = _newest_with_section("RESILIENCE_r[0-9]*.json", repo, "router")
+    if p:
+        # Fleet-serving evidence (tools/router_drill.py): SIGKILL
+        # under Poisson load absorbed by failover, rolling reload with
+        # zero failed requests, hedging beating no-hedge p99.
+        d = as_dict(_load(p))
+        router = d.get("router") or {}
+        kill = router.get("kill") or {}
+        hedge = router.get("hedge") or {}
+        reload_ = router.get("reload") or {}
+        add("fleet serving", p, {
+            "value": kill.get("availability"),
+            "unit": "availability under replica SIGKILL",
+            "platform": d.get("platform"),
+            "replicas": router.get("replicas"),
+            "hedge_p99_ratio": hedge.get("p99_ratio"),
+            "ok": (d.get("ok") is True
+                   and int(kill.get("failed_5xx", -1)) == 0
+                   and int(reload_.get("failed_5xx", -1)) == 0
+                   and bool(reload_.get("model_step_advanced")))})
+    p = _newest_with_section("RESILIENCE_r[0-9]*.json", repo, "integrity")
+    if p:
+        # Gradient-integrity evidence (tools/poison_drill.py): poisoned
+        # contributor quarantined and readmitted on the real wire, digests
+        # catching bit-flips, no-screen control diverging, <2% overhead.
+        d = as_dict(_load(p))
+        integ = d.get("integrity") or {}
+        add("gradient integrity", p, {
+            "value": integ.get("quarantines"),
+            "unit": "quarantines (readmitted {}, wire fails {})".format(
+                integ.get("readmissions"),
+                integ.get("wire_integrity_failures")),
+            "platform": d.get("platform"),
+            "overhead_frac": integ.get("overhead_frac"),
+            "ok": (d.get("ok") is True
+                   and int(integ.get("crashes", -1)) == 0
+                   and bool(integ.get("control_diverged")))})
     p = _newest("BENCH_WIRE_r[0-9]*.json", repo)
     if p:
         # Wire-overlap evidence (bench_suite wire_blocking_*/wire_overlapped_*

@@ -13,13 +13,21 @@ every committed artifact stays comparable.
 
 Fallbacks keep stdlib behavior exact: tiny inputs (where vectorization
 costs more than it saves), non-alphabet characters, and radix overflow all
-delegate to :mod:`base64`, which raises the same ``ValueError`` messages
-callers may match on.
+delegate to :mod:`base64`. Malformed input raises :class:`WireCorrupt` — a
+``ValueError`` subclass carrying the stdlib's message — so the integrity
+layer can classify a decode failure as a digest-equivalent wire-corruption
+event instead of pattern-matching bare ValueErrors from numpy internals.
 """
 
 import base64
 
 import numpy as np
+
+
+class WireCorrupt(ValueError):
+    """Armoured wire text failed to decode (bad character, radix overflow,
+    non-ASCII, or torn/odd-length framing). Subclasses ``ValueError`` so
+    pre-existing callers that caught ValueError keep working."""
 
 # base64._b85alphabet, spelled out rather than imported (private name).
 _ALPHABET = (b"0123456789ABCDEFGHIJKLMNOPQRSTUVWXYZ"
@@ -31,6 +39,13 @@ _PAD = ord("~")  # decode pads the TEXT with '~' (digit 84), like stdlib
 
 # Below this the numpy round-trips cost more than the pure-Python loop.
 _SMALL = 512
+
+
+def _delegate_decode(data) -> bytes:
+    try:
+        return base64.b85decode(data)
+    except ValueError as e:
+        raise WireCorrupt(str(e)) from e
 
 
 def b85encode(data) -> bytes:
@@ -55,27 +70,31 @@ def b85encode(data) -> bytes:
 
 def b85decode(data) -> bytes:
     """base64.b85decode(data), vectorized. Accepts str or bytes-like input;
-    malformed input raises the stdlib's exact ValueError (via delegation)."""
+    malformed input raises :class:`WireCorrupt` with the stdlib's exact
+    message."""
     if isinstance(data, str):
-        data = data.encode("ascii")
+        try:
+            data = data.encode("ascii")
+        except UnicodeEncodeError as e:
+            raise WireCorrupt(f"non-ASCII armoured text: {e}") from e
     elif not isinstance(data, (bytes, bytearray)):
         data = memoryview(data).tobytes()
     n = len(data)
     if n < _SMALL:
-        return base64.b85decode(data)
+        return _delegate_decode(data)
     padding = (-n) % 5
     arr = np.frombuffer(data, np.uint8)
     if padding:
         arr = np.concatenate([arr, np.full(padding, _PAD, np.uint8)])
     digits = _DEC[arr]
     if (digits == 0xFF).any():
-        return base64.b85decode(data)  # exact bad-character ValueError
+        return _delegate_decode(data)  # exact bad-character message
     g = digits.reshape(-1, 5)
     acc = g[:, 0].astype(np.uint64)
     for i in range(1, 5):
         acc *= 85
         acc += g[:, i]
     if (acc > 0xFFFFFFFF).any():
-        return base64.b85decode(data)  # exact overflow ValueError
+        return _delegate_decode(data)  # exact overflow message
     raw = acc.astype(">u4").tobytes()
     return raw[:-padding] if padding else raw

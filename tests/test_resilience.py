@@ -375,6 +375,28 @@ def test_latest_valid_step_walks_past_corruption(tmp_path):
     np.testing.assert_array_equal(state["w"], tree["w"] * 4)
 
 
+def test_extra_state_corruption_falls_back(tmp_path):
+    """manifest.json covers extra_state.msgpack: a flipped byte in the EF
+    residual blob invalidates the WHOLE checkpoint, and resume falls back
+    to the previous valid one instead of restoring a torn residual."""
+    tree = {"w": np.ones(100, np.float32)}
+    extra = {"ef": {"r0": np.linspace(0, 1, 500).astype(np.float32)}}
+    for s in (2, 4):
+        ckpt.save_checkpoint(str(tmp_path), s, {"w": tree["w"] * s},
+                             extra_state=extra)
+    assert corrupt_file(os.path.join(
+        ckpt.checkpoint_path(str(tmp_path), 4), "extra_state.msgpack"))
+    assert not ckpt.verify_checkpoint(str(tmp_path), 4)
+    with pytest.raises(ckpt.CheckpointCorruptError):
+        ckpt.load_extra_state(str(tmp_path), 4)
+    assert ckpt.latest_valid_step(str(tmp_path)) == 2
+    state, meta, _, step = ckpt.load_latest_valid(str(tmp_path), tree)
+    assert step == 2 and meta["step"] == 2
+    np.testing.assert_array_equal(state["w"], tree["w"] * 2)
+    restored = ckpt.load_extra_state(str(tmp_path), 2)
+    np.testing.assert_array_equal(restored["ef"]["r0"], extra["ef"]["r0"])
+
+
 def test_load_latest_valid_none_when_all_corrupt(tmp_path):
     tree = {"w": np.ones(10, np.float32)}
     ckpt.save_checkpoint(str(tmp_path), 1, tree)

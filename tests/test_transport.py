@@ -116,7 +116,8 @@ def test_wire_stats_count_armoured_bytes():
                                    "wire_raw_bytes_out": 0,
                                    "param_publishes": 0,
                                    "last_param_publish_bytes": 0,
-                                   "wire_read_errors": 0}
+                                   "wire_read_errors": 0,
+                                   "wire_integrity_failures": 0}
     writer.submit_grads(0, seq=1, step=0, grads=_tree(1))
     writer.publish_params(1, _tree(2))
     st = writer.wire_stats()
