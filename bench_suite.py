@@ -788,6 +788,10 @@ class LatencyKV:
         self._wait(key)
         self.inner.delete(key)
 
+    def keys(self, prefix=""):
+        self._wait(prefix)
+        return self.inner.keys(prefix)
+
 
 def bench_wire(name, steps, *, payload_mb=64, leaf_kb=1024, codec="blosc",
                bucket_mb=4.0, workers=4, rtt_ms=2.0, trace_out=""):
@@ -1314,6 +1318,61 @@ def bench_elastic_overhead(name, steps, *, batch=256, reps=3):
             "overhead_frac": round(frac, 5), "ok": frac < 0.02}
 
 
+def bench_kvrep_overhead(name, steps, *, payload_mb=24, leaf_kb=1024,
+                         codec="blosc", bucket_mb=4.0, workers=4,
+                         rtt_ms=2.0, n_backends=3, reps=5):
+    """Quorum-replication cost row (ISSUE 14, runtime/kvrep.py): the wire
+    bench's publish+read — the SAME payload through the SAME overlapped
+    KVPytreeChannel at the same RTT — over one LatencyKV (the single
+    store every consumer ran on before --kv-replicas) and over a
+    ReplicatedKV spanning n_backends LatencyKVs. Writes fan out in
+    parallel (wall cost = slowest responder, not the sum) and reads tag-
+    compare headers without copying each replica's payload, so the
+    replicated wall time is one RTT plus a fixed ~0.1 ms dispatch tax per
+    op — amortized over wire-sized values that is the <5% overhead_frac
+    this row asserts and the kvrep regress family gates. min-of-reps on
+    both legs; payload equality is asserted on the replicated leg (the
+    quorum plane may not perturb the wire)."""
+    from ps_pytorch_tpu.parallel.transport import KVPytreeChannel
+    from ps_pytorch_tpu.runtime.coordinator import KVStore
+    from ps_pytorch_tpu.runtime.kvrep import ReplicatedKV
+
+    rtt_s = rtt_ms / 1e3
+    n_leaves = max(int(payload_mb * 1024 // leaf_kb), 1)
+    per_leaf = int(leaf_kb * 1024 // 4)
+    rng = np.random.default_rng(0)
+    tree = {f"l{i:04d}": rng.normal(size=(per_leaf,))
+            .astype(np.float32) / 4.0 for i in range(n_leaves)}
+    bucket_bytes = int(bucket_mb * (1 << 20))
+
+    def run(kv) -> float:
+        writer = KVPytreeChannel(kv, "bench/kvrep", tree, codec=codec,
+                                 bucket_bytes=bucket_bytes, workers=workers)
+        reader = KVPytreeChannel(kv, "bench/kvrep", tree, codec=codec,
+                                 bucket_bytes=bucket_bytes, workers=workers)
+        t0 = time.perf_counter()
+        writer.publish(1, tree)
+        got = reader.read()
+        dt = time.perf_counter() - t0
+        assert got is not None and got[0] == 1
+        for k in tree:
+            np.testing.assert_array_equal(got[1][k], tree[k])
+        return dt
+
+    single_s = min(run(LatencyKV(KVStore(), rtt_s)) for _ in range(reps))
+    replicated_s = min(
+        run(ReplicatedKV([LatencyKV(KVStore(), rtt_s)
+                          for _ in range(n_backends)], writer="bench"))
+        for _ in range(reps))
+    frac = (replicated_s - single_s) / single_s
+    return {"config": name, "platform": "host", "payload_mb": payload_mb,
+            "leaves": n_leaves, "codec": codec, "bucket_mb": bucket_mb,
+            "workers": workers, "rtt_ms": rtt_ms, "n_backends": n_backends,
+            "reps": reps, "single_s": round(single_s, 5),
+            "replicated_s": round(replicated_s, 5),
+            "overhead_frac": round(frac, 5), "ok": frac < 0.05}
+
+
 CONFIGS = {
     "lenet_mnist_single": lambda steps: bench_throughput(
         "lenet_mnist_single", "LeNet", "synthetic_mnist", 128, steps,
@@ -1473,6 +1532,13 @@ CONFIGS = {
     # screen cost for a 4-contributor round; same <2% posture.
     "integrity_overhead": lambda steps: bench_integrity_overhead(
         "integrity_overhead", max(steps, 30)),
+    # -- quorum-replicated coordination plane (ISSUE 14, runtime/kvrep.py):
+    # the wire bench's 24 MB publish+read, 1 store vs majority-write/
+    # newest-read over 3 at the same 2 ms RTT; parallel fan-out + header-
+    # only tag peeks keep the per-op wall cost at one RTT, so the row
+    # asserts the <5% budget the kvrep regress family gates.
+    "kvrep_overhead": lambda steps: bench_kvrep_overhead(
+        "kvrep_overhead", steps),
     # -- hierarchical multi-hop sync (ISSUE 11, parallel/hierarchy.py):
     # flat star vs 2-tier tree over the per-link LatencyKV (fast
     # intra-group, 20-50 ms inter-region). Each row carries BOTH legs;

@@ -306,10 +306,20 @@ class ErrorFeedback:
     ``compensate`` adds the carried residual before encode; ``update``
     stores what the codec dropped. State is plain numpy keyed by leaf
     index — serializable through runtime/checkpoint.py extra state for
-    bit-for-bit --auto-resume."""
+    bit-for-bit --auto-resume.
 
-    def __init__(self):
+    ``clip`` (--ef-clip) caps the per-leaf residual L2 norm. Without it,
+    EF is an integrity bypass: a poisoned contribution the MAD screen
+    rejects gets ABSORBED into the sender's residual and re-emitted over
+    later steps in validator-legal slices (PERF.md §17 documented this
+    gap in PR 13 and disabled EF in the quarantine drill). Clamping the
+    carried residual bounds what any one poisoned step can smuggle to a
+    ~clip-sized perturbation — honest codec residuals sit far below any
+    sane clip, so convergence-mode EF is unaffected."""
+
+    def __init__(self, clip: float = 0.0):
         self._r: Dict[int, np.ndarray] = {}
+        self.clip = float(clip)
 
     def compensate(self, leaf_index: int, x: np.ndarray) -> np.ndarray:
         r = self._r.get(leaf_index)
@@ -317,7 +327,12 @@ class ErrorFeedback:
 
     def update(self, leaf_index: int, compensated: np.ndarray,
                decoded: np.ndarray) -> None:
-        self._r[leaf_index] = compensated - decoded
+        r = compensated - decoded
+        if self.clip > 0.0:
+            norm = float(np.linalg.norm(r.astype(np.float64)))
+            if norm > self.clip:
+                r = (r * np.float32(self.clip / norm)).astype(r.dtype)
+        self._r[leaf_index] = r
 
     def residual_nbytes(self) -> int:
         return sum(int(r.nbytes) for r in self._r.values())

@@ -91,6 +91,7 @@ class TrainConfig:
     grad_codec: str = "blosc"        # blosc | int8 (on-device Pallas) | int8lat/topk/randk (homomorphic: leader sums in the compressed domain, compression/codecs.py)
     grad_topk_frac: float = 0.01     # topk/randk: fraction of entries kept per leaf
     ef: bool = False                 # sender-side error feedback for lossy homomorphic codecs (residual carried across steps, checkpointed)
+    ef_clip: float = 0.0             # per-leaf L2 cap on the EF residual; 0 = unclamped. Bounds what an absorbed poisoned gradient can re-emit through the validator-legal band (PERF.md §17/§18)
 
     # -- overlapped gradient wire (parallel/buckets.py + transport.py; the
     #    reference's per-layer send-during-backward, resnet_split.py:25-42) --
@@ -126,6 +127,9 @@ class TrainConfig:
     kv_retry_attempts: int = 5       # attempts per KV op on transient coordination-service errors; 1 = no retries
     kv_retry_base_s: float = 0.05    # backoff base (exponential x2, jittered, capped at 2 s)
     kv_retry_budget: int = 1000      # run-wide retry budget before failing fast; 0 = unbounded
+    kv_replicas: str = ""            # quorum-replicated coordination plane: comma-separated backend specs (dir:<path> | http://host:port | mem:), e.g. "dir:/mnt/a,dir:/mnt/b,dir:/mnt/c"; "" = single unreplicated backend (runtime/kvrep.py)
+    kv_quorum: int = 0               # write/read quorum over the kv_replicas backends; 0 = majority (N//2+1). Must stay > N/2 so any two quorums overlap
+    kv_resync_s: float = 1.0         # probation base for an ejected KV backend: first rejoin probe (+ anti-entropy resync) after this many seconds, growing 2x per consecutive failure (jittered)
     ckpt_keep: int = 0               # keep-last-N committed checkpoints; 0 = keep all
     auto_resume: int = 0             # max automatic restarts from the latest VALID checkpoint after a crash (train.py)
     leader_lease_s: float = 0.0      # leader refreshes a coordination-KV lease this often; followers raise LeaderLost when it goes stale (0 = lease off; runtime/coordinator.py)
@@ -249,6 +253,49 @@ class TrainConfig:
                      "kv_retry_base_s"):
             if getattr(self, name) < 0:
                 raise ValueError(f"{name} must be >= 0")
+        if 0 < self.heartbeat_timeout_s <= self.heartbeat_interval_s:
+            # An inverted deadline can NEVER be met: every process looks
+            # dead between its own beats and membership flaps forever.
+            # Reject at config time with the fix in the message instead
+            # of letting the run silently evict healthy replicas.
+            raise ValueError(
+                f"heartbeat_timeout_s={self.heartbeat_timeout_s} <= "
+                f"heartbeat_interval_s={self.heartbeat_interval_s}: a beat "
+                f"can never land inside its own deadline, so liveness "
+                f"flaps instead of detecting death. Set heartbeat_timeout_s "
+                f"> heartbeat_interval_s (0 = 3x interval), or 0 for the "
+                f"default.")
+        if 0 < self.heartbeat_timeout_s <= self.leader_lease_s:
+            # Same inversion one layer up: the leader refreshes its lease
+            # every leader_lease_s, so a liveness deadline at or below the
+            # lease period evicts a healthy leader between refreshes.
+            raise ValueError(
+                f"heartbeat_timeout_s={self.heartbeat_timeout_s} <= "
+                f"leader_lease_s={self.leader_lease_s}: the leader beats "
+                f"at the lease cadence, so this deadline evicts a healthy "
+                f"leader between refreshes. Set heartbeat_timeout_s > "
+                f"leader_lease_s (0 = derived default).")
+        if self.kv_replicas:
+            # Parse + quorum-math check now (same config-time discipline
+            # as fault_spec): a typo'd backend or an unsafe quorum must
+            # fail before anything is wired under the trainers.
+            from ps_pytorch_tpu.runtime.kvrep import parse_backend_specs
+            n_rep = len(parse_backend_specs(self.kv_replicas))
+            majority = n_rep // 2 + 1
+            if self.kv_quorum and not majority <= self.kv_quorum <= n_rep:
+                raise ValueError(
+                    f"kv_quorum={self.kv_quorum} is unsafe for {n_rep} "
+                    f"replicas: any two quorums must overlap, so it must "
+                    f"be in [{majority}, {n_rep}] (0 = majority).")
+        if self.kv_quorum < 0:
+            raise ValueError(f"kv_quorum={self.kv_quorum} (must be >= 0; "
+                             "0 = majority)")
+        if self.kv_resync_s <= 0:
+            raise ValueError(f"kv_resync_s={self.kv_resync_s} "
+                             "(must be > 0)")
+        if self.ef_clip < 0:
+            raise ValueError(f"ef_clip={self.ef_clip} (must be >= 0; "
+                             "0 = unclamped residual)")
         if self.ckpt_keep < 0 or self.kv_retry_budget < 0 or \
                 self.auto_resume < 0:
             raise ValueError("ckpt_keep / kv_retry_budget / auto_resume "

@@ -50,7 +50,8 @@ class StaleGradientAggregator:
                  compress: bool = False, codec_level: int = 3,
                  codec: str = "blosc", wire_bucket_bytes: int = 0,
                  wire_workers: int = 0, topk_frac: float = 0.01,
-                 error_feedback: bool = False, integrity: Any = None):
+                 error_feedback: bool = False, ef_clip: float = 0.0,
+                 integrity: Any = None):
         from ps_pytorch_tpu.compression.codecs import (
             EF_GRAD_CODECS, GRAD_CODECS, HOMOMORPHIC_GRAD_CODECS,
             require_codec,
@@ -86,6 +87,7 @@ class StaleGradientAggregator:
         self._homomorphic = codec in HOMOMORPHIC_GRAD_CODECS
         self.topk_frac = float(topk_frac)
         self.error_feedback = bool(error_feedback)
+        self.ef_clip = float(ef_clip)
         # Sender-side EF residuals, one accumulator per slice (in-process
         # callers submit raw grads here; wire callers run EF in their own
         # process and submit pre-encoded payloads via submit_encoded).
@@ -206,7 +208,7 @@ class StaleGradientAggregator:
         if self.error_feedback:
             ef = self._ef.get(slice_id)
             if ef is None:
-                ef = self._ef[slice_id] = ErrorFeedback()
+                ef = self._ef[slice_id] = ErrorFeedback(clip=self.ef_clip)
         return encode_leaves(self.codec, leaves, slice_id=slice_id,
                              step=step, frac=self.topk_frac, ef=ef,
                              bucket_bytes=self.wire_bucket_bytes,
@@ -231,7 +233,7 @@ class StaleGradientAggregator:
         from ps_pytorch_tpu.compression.codecs import ErrorFeedback
         self._ef = {}
         for sid, d in (state or {}).items():
-            ef = ErrorFeedback()
+            ef = ErrorFeedback(clip=self.ef_clip)
             ef.load_state_dict(d)
             self._ef[int(sid)] = ef
 

@@ -149,7 +149,8 @@ class GroupAggregator:
 
     def __init__(self, plan: HierarchyPlan, gid: int, codec: str,
                  staleness_limit: int = 4, topk_frac: float = 0.01,
-                 hop_ef: bool = False, integrity: Any = None):
+                 hop_ef: bool = False, ef_clip: float = 0.0,
+                 integrity: Any = None):
         require_codec("grad_codec", codec, HOMOMORPHIC_GRAD_CODECS)
         self.plan = plan
         self.gid = int(gid)
@@ -165,7 +166,7 @@ class GroupAggregator:
             plan.n, staleness_limit=staleness_limit, staleness_decay=0.0,
             num_aggregate=0, compress=True, codec=codec,
             topk_frac=topk_frac, integrity=integrity)
-        self._ef = ErrorFeedback() if hop_ef else None
+        self._ef = ErrorFeedback(clip=ef_clip) if hop_ef else None
         self.hops = 0
 
     def submit_encoded(self, slice_id: int, step: int, tree: Any) -> None:
@@ -433,6 +434,7 @@ class HierarchicalAggregator:
                  staleness_limit: int = 4, staleness_decay: float = 0.0,
                  num_aggregate: int = 0, codec: str = "int8lat",
                  topk_frac: float = 0.01, error_feedback: bool = False,
+                 ef_clip: float = 0.0,
                  hop_ef: bool = True, intra_every: int = 1,
                  inter_every: int = 1,
                  on_event: Optional[Callable[[str, int, int, int], None]]
@@ -450,13 +452,15 @@ class HierarchicalAggregator:
         self._members = StaleGradientAggregator(
             n_slices, staleness_limit=staleness_limit, staleness_decay=0.0,
             num_aggregate=0, compress=True, codec=codec,
-            topk_frac=topk_frac, error_feedback=error_feedback)
+            topk_frac=topk_frac, error_feedback=error_feedback,
+            ef_clip=ef_clip)
         # Member ids are globally unique across groups, so ONE member-space
         # GradIntegrity (strike ledger) is shared by every group hop; the
         # root gets its own over the group id space.
         self._groups = [GroupAggregator(self.plan, g, codec,
                                         staleness_limit=staleness_limit,
                                         topk_frac=topk_frac, hop_ef=hop_ef,
+                                        ef_clip=ef_clip,
                                         integrity=integrity)
                         for g in range(self.plan.n_groups)]
         self.root = RootAggregator(

@@ -13,8 +13,8 @@ from ps_pytorch_tpu.resilience.autoresume import (  # noqa: F401
     PreemptionGuard, run_with_auto_resume,
 )
 from ps_pytorch_tpu.resilience.faults import (  # noqa: F401
-    FaultInjector, FaultyKV, InjectedCrash, ManualClock, TransientKVError,
-    corrupt_file, parse_fault_spec,
+    BackendFaultyKV, FaultInjector, FaultyKV, InjectedCrash, ManualClock,
+    TransientKVError, corrupt_file, parse_fault_spec,
 )
 from ps_pytorch_tpu.resilience.heartbeat import (  # noqa: F401
     Heartbeat, LivenessMonitor,

@@ -83,6 +83,21 @@ Spec grammar (``;``-separated faults, each ``kind:key=val,key=val``):
         half. Depending on framing this surfaces as a digest mismatch or
         an armor ``WireCorrupt``/short-buffer decode error; either way the
         reader must demote the read ("absent this round"), never crash.
+    kv_backend_kill:backend=1,step=5[,steps=0]
+        Replica-plane outage for ONE backend of a ReplicatedKV
+        (runtime/kvrep.py): every op routed to backend ``backend``
+        raises the transient UNAVAILABLE for the step window
+        [step, step+steps) (steps=0: to end of run). Unlike
+        ``kv_partition`` this is below the quorum layer — the
+        replication math (majority writes, newest-of-quorum reads,
+        ejection + probation) must absorb it WITHOUT the retry budget
+        ever being charged; the drills assert exactly that.
+    kv_backend_wipe:backend=1,step=8
+        Backend ``backend`` loses its entire keyspace at step ``step``
+        (once) — the lost-disk half of the replica failure model. The
+        wiped backend keeps serving (empty), so newest-of-quorum reads
+        mask it immediately and anti-entropy resync must repair it back
+        to tag-equality.
     grad_poison:scale=1000,r=2[,step=0][,steps=0]
         Process ``r`` multiplies its LOCAL gradients by ``scale`` before
         encode for every step in [step, step+steps) (steps=0: to end of
@@ -105,12 +120,16 @@ import numpy as np
 
 _KINDS = ("kv_drop", "kv_delay", "replica_crash", "ckpt_corrupt", "grad_nan",
           "leader_kill", "kv_partition", "link_jitter", "replica_kill",
-          "payload_bitflip", "payload_truncate", "grad_poison")
+          "payload_bitflip", "payload_truncate", "grad_poison",
+          "kv_backend_kill", "kv_backend_wipe")
 _KV_OPS = ("set", "get", "delete")
 # The kinds FaultyKV enforces (everything else fires from the step /
 # checkpoint / serving planes).
 _KV_FAULT_KINDS = ("kv_drop", "kv_delay", "kv_partition", "link_jitter",
                    "payload_bitflip", "payload_truncate")
+# The kinds BackendFaultyKV enforces — scoped to ONE replica of a
+# ReplicatedKV, injected INSIDE the quorum layer via ``wrap_backend``.
+_BACKEND_FAULT_KINDS = ("kv_backend_kill", "kv_backend_wipe")
 # base64's b85 alphabet (spelled out; resilience/ stays a leaf): bitflips
 # substitute IN-alphabet so the armour still decodes and only the wire
 # digest can tell.
@@ -282,6 +301,17 @@ def _validate(p: Dict[str, Any], part: str) -> None:
         if not isinstance(p.setdefault("steps", 0), int) or p["steps"] < 0:
             raise ValueError(f"grad_poison steps must be an int >= 0 "
                              f"(0 = to end of run) (got {part!r})")
+    elif kind in _BACKEND_FAULT_KINDS:
+        if not isinstance(p.get("backend"), int) or p["backend"] < 0:
+            raise ValueError(f"{kind} needs backend=<int >= 0> "
+                             f"(got {part!r})")
+        if not isinstance(p.get("step"), int) or p["step"] < 0:
+            raise ValueError(f"{kind} needs step=<int >= 0> (got {part!r})")
+        if kind == "kv_backend_kill":
+            if not isinstance(p.setdefault("steps", 0), int) or \
+                    p["steps"] < 0:
+                raise ValueError(f"kv_backend_kill steps must be an int >= 0 "
+                                 f"(0 = to end of run) (got {part!r})")
     elif kind == "link_jitter":
         s = p.get("s")
         if not isinstance(s, (int, float)) or s <= 0:
@@ -403,6 +433,70 @@ class FaultyKV:
         self._roll("delete", key)
         self.inner.delete(key)
 
+    def keys(self, prefix: str = ""):
+        # Scans ride the same fault plane as point ops (a partition
+        # blocks discovery too); op-filtered faults never name "keys",
+        # so only total/unfiltered kinds apply.
+        self._roll("keys", prefix)
+        return self.inner.keys(prefix)
+
+
+class BackendFaultyKV:
+    """KVStore-shaped shim for ONE replica of a ReplicatedKV: enforces the
+    ``kv_backend_kill`` (step-windowed total outage) and
+    ``kv_backend_wipe`` (once: drop the whole keyspace, keep serving)
+    kinds for its backend index. Sits INSIDE the quorum layer, so the
+    replication math — not the retry plane — is what must absorb it."""
+
+    def __init__(self, inner, faults: List[Dict[str, Any]],
+                 injector: "FaultInjector", backend_index: int):
+        self.inner = inner
+        self._faults = [f for f in faults if f["backend"] == backend_index]
+        self._inj = injector
+        self.backend_index = int(backend_index)
+
+    def _roll(self, op: str) -> None:
+        step = self._inj.current_step
+        for i, f in enumerate(self._faults):
+            if f["kind"] == "kv_backend_wipe":
+                if ("bwipe", self.backend_index, i) in self._inj._fired or \
+                        step < f["step"]:
+                    continue
+                self._inj._fired.add(("bwipe", self.backend_index, i))
+                # Wipe FIRST, then serve the op against the emptied
+                # store — the lost-disk replica answers, wrongly.
+                for k in list(self.inner.keys("")):
+                    self.inner.delete(k)
+                self._inj.counters["kv_backend_wipes"] += 1
+            elif f["kind"] == "kv_backend_kill":
+                if step < f["step"]:
+                    continue
+                if f["steps"] > 0 and step >= f["step"] + f["steps"]:
+                    continue
+                if ("bkill", self.backend_index, i) not in self._inj._fired:
+                    self._inj._fired.add(("bkill", self.backend_index, i))
+                    self._inj.counters["kv_backend_kills"] += 1
+                self._inj.counters["kv_backend_drops"] += 1
+                raise TransientKVError(
+                    f"UNAVAILABLE: injected kv_backend_kill on backend "
+                    f"{self.backend_index} {op} (step {step})")
+
+    def set(self, key: str, value: str) -> None:
+        self._roll("set")
+        self.inner.set(key, value)
+
+    def get(self, key: str, default: Optional[str] = None) -> Optional[str]:
+        self._roll("get")
+        return self.inner.get(key, default)
+
+    def delete(self, key: str) -> None:
+        self._roll("delete")
+        self.inner.delete(key)
+
+    def keys(self, prefix: str = ""):
+        self._roll("keys")
+        return self.inner.keys(prefix)
+
 
 class FaultInjector:
     """One injector per process, owning the parsed spec, the fired-fault
@@ -428,7 +522,9 @@ class FaultInjector:
             "kv_drops": 0, "kv_delays": 0, "crashes": 0,
             "ckpt_corruptions": 0, "grad_nans": 0, "leader_kills": 0,
             "kv_partition_drops": 0, "link_jitters": 0, "replica_kills": 0,
-            "payload_bitflips": 0, "payload_truncates": 0, "grad_poisons": 0}
+            "payload_bitflips": 0, "payload_truncates": 0, "grad_poisons": 0,
+            "kv_backend_kills": 0, "kv_backend_wipes": 0,
+            "kv_backend_drops": 0}
 
     # ---- KV plane ----
     @property
@@ -441,6 +537,22 @@ class FaultInjector:
         if not kv_faults:
             return kv
         return FaultyKV(kv, kv_faults, self, self.sleep)
+
+    @property
+    def has_backend_faults(self) -> bool:
+        return any(f["kind"] in _BACKEND_FAULT_KINDS for f in self.faults)
+
+    def wrap_backend(self, kv, backend_index: int):
+        """Per-replica shim for ReplicatedKV backends: only the
+        ``kv_backend_*`` kinds naming ``backend_index`` apply. Applied
+        INSIDE the quorum layer (runtime/kvrep.py build_replicated_kv),
+        so a killed/wiped backend exercises ejection + anti-entropy,
+        never the caller-visible retry path."""
+        faults = [f for f in self.faults
+                  if f["kind"] in _BACKEND_FAULT_KINDS]
+        if not any(f["backend"] == backend_index for f in faults):
+            return kv
+        return BackendFaultyKV(kv, faults, self, backend_index)
 
     # ---- step loop plane ----
     def maybe_crash(self, step: int) -> None:

@@ -157,5 +157,8 @@ class RetryingKV:
     def delete(self, key: str) -> None:
         self._call(self.inner.delete, key)
 
+    def keys(self, prefix: str = ""):
+        return self._call(self.inner.keys, prefix)
+
     def snapshot(self) -> Dict[str, int]:
         return dict(self.counters)

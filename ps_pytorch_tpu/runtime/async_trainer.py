@@ -46,7 +46,7 @@ from ps_pytorch_tpu.runtime.multislice import make_slice_grad_fn
 from ps_pytorch_tpu.telemetry import (
     MetricsExporter, Registry, Tracer, declare_elastic_metrics,
     declare_hierarchy_metrics, declare_integrity_metrics,
-    declare_resilience_metrics,
+    declare_kvrep_metrics, declare_resilience_metrics,
     declare_training_metrics, device_memory_record, host_rss_bytes,
     set_default_tracer,
 )
@@ -92,14 +92,31 @@ class AsyncTrainer:
         self.grad_fn = make_slice_grad_fn(self.model, self.mesh, self.has_bn,
                                           self._input_norm)
 
+        # The injector is built BEFORE the KV so the per-backend fault
+        # kinds (kv_backend_kill/wipe) can be threaded INSIDE the quorum
+        # layer while the logical kinds still wrap outside it.
+        injector = None
+        if cfg.fault_spec:
+            injector = resilience.FaultInjector(cfg.fault_spec,
+                                                process_index=self.pid)
+        self._kvrep = None
         if kv is None:
-            kv = DistributedKV() if self.n > 1 else KVStore()
+            if cfg.kv_replicas:
+                # Quorum-replicated coordination plane (runtime/kvrep.py):
+                # N independent backends under the same KV interface —
+                # elections, membership, the wire, and the ledger all run
+                # unchanged while any minority of backends dies.
+                from ps_pytorch_tpu.runtime.kvrep import build_replicated_kv
+                kv = self._kvrep = build_replicated_kv(
+                    cfg, process_index=self.pid, injector=injector)
+            else:
+                kv = DistributedKV() if self.n > 1 else KVStore()
         # Resilience shims around the control plane: seeded fault injection
         # inside (when --fault-spec names kv faults), jittered-backoff
         # retries outside — the transport and aggregator see one hardened
         # KV without knowing either layer exists.
-        kv, self.injector, self._retrier = resilience.wrap_kv(
-            kv, cfg, process_index=self.pid)
+        kv, self.injector, self._retrier = resilience.wrap_kv_with(
+            kv, cfg, injector)
         # Elastic control plane (--elastic): the PS-leader role becomes a
         # lease over the coordination KV instead of the pid==0 birthright.
         # The initial leader is --elastic-leader (keep it OFF process 0 in
@@ -259,6 +276,9 @@ class AsyncTrainer:
         if self.injector is not None or self._retrier is not None:
             declare_resilience_metrics(self.registry)
             collect.append(self._pump_resilience_metrics)
+        if self._kvrep is not None:
+            declare_kvrep_metrics(self.registry)
+            collect.append(self._pump_kvrep_metrics)
         if cfg.grad_integrity:
             declare_integrity_metrics(self.registry)
             collect.append(self._pump_integrity_metrics)
@@ -348,6 +368,23 @@ class AsyncTrainer:
                 continue            # snapshot key with no declared metric
             if delta > 0:
                 self.registry.inc(name, delta)
+
+    def _pump_kvrep_metrics(self) -> None:
+        """Refresh kvrep_* registry metrics from the live ReplicatedKV
+        snapshot (delta-inc for counters, set for the health gauges) —
+        same collect-hook discipline as the resilience pump."""
+        for name, value in self._kvrep.snapshot().items():
+            try:
+                delta = value - self.registry.get(name)
+            except KeyError:
+                continue
+            if delta > 0:
+                self.registry.inc(name, delta)
+        for name, value in self._kvrep.gauges().items():
+            try:
+                self.registry.set(name, value)
+            except KeyError:
+                continue
 
     def _integrity_event(self, kind: str, cid: int, step: int,
                          detail: str) -> None:
@@ -485,7 +522,7 @@ class AsyncTrainer:
         extra = ckpt.load_extra_state(self.cfg.train_dir, step)
         if extra and "ef" in extra:
             from ps_pytorch_tpu.compression.codecs import ErrorFeedback
-            self._ef = ErrorFeedback()
+            self._ef = ErrorFeedback(clip=self.cfg.ef_clip)
             self._ef.load_state_dict(extra["ef"])
         print(f"RESUME from {ckpt.checkpoint_path(self.cfg.train_dir, step)} "
               f"at step {self.version}")
@@ -498,7 +535,7 @@ class AsyncTrainer:
                 ErrorFeedback, encode_leaves,
             )
             if self.cfg.ef and self._ef is None:
-                self._ef = ErrorFeedback()
+                self._ef = ErrorFeedback(clip=self.cfg.ef_clip)
             leaves, treedef = jax.tree.flatten(grads)
             # Per-bucket streaming: encode + EF-update of bucket k runs on
             # the pool while bucket k+1 is still syncing off-device — the

@@ -180,7 +180,21 @@ class FileKV(KVStore):
         try:
             with os.fdopen(fd, "w") as f:
                 f.write(value)
+                # Durability, not just atomicity: rename alone survives
+                # process death but a host power cut can commit the
+                # rename while the DATA is still in the page cache —
+                # readers would then see an empty/torn "committed" key.
+                # fsync the bytes before the rename, and the directory
+                # after it so the rename itself is on disk too.
+                f.flush()
+                os.fsync(fd)
             os.replace(tmp, path)
+            dfd = os.open(self._root,
+                          os.O_RDONLY | getattr(os, "O_DIRECTORY", 0))
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
         except BaseException:
             try:
                 os.unlink(tmp)

@@ -123,6 +123,7 @@ class MultiSliceTrainer:
                 staleness_decay=cfg.staleness_decay,
                 num_aggregate=cfg.num_aggregate, codec=cfg.grad_codec,
                 topk_frac=cfg.grad_topk_frac, error_feedback=cfg.ef,
+                ef_clip=cfg.ef_clip,
                 intra_every=cfg.sync_intra_every,
                 inter_every=cfg.sync_inter_every)
         else:
@@ -133,7 +134,8 @@ class MultiSliceTrainer:
                 codec=cfg.grad_codec, codec_level=cfg.codec_level,
                 wire_bucket_bytes=int(cfg.wire_bucket_mb * (1 << 20)),
                 wire_workers=cfg.wire_workers,
-                topk_frac=cfg.grad_topk_frac, error_feedback=cfg.ef)
+                topk_frac=cfg.grad_topk_frac, error_feedback=cfg.ef,
+                ef_clip=cfg.ef_clip)
         from ps_pytorch_tpu.data.augment import input_norm_for
         self._input_norm = input_norm_for(cfg)
         self.grad_fns = [make_slice_grad_fn(self.model, m, self.has_bn,

@@ -39,7 +39,8 @@ from ps_pytorch_tpu.runtime.metrics import MetricsLogger
 from ps_pytorch_tpu.telemetry import (
     FlightRecorder, HealthMonitor, MetricsExporter, Registry,
     TelemetryAggregator, Tracer, aggregate_peak_flops,
-    declare_resilience_metrics, declare_training_metrics,
+    declare_kvrep_metrics, declare_resilience_metrics,
+    declare_training_metrics,
     derive_step_record, device_memory_record, host_rss_bytes,
     set_default_tracer, step_flops_of,
 )
@@ -104,9 +105,19 @@ class Trainer:
             self.injector = resilience.FaultInjector(
                 cfg.fault_spec, process_index=jax.process_index())
         self._retrier = None
+        self._kvrep = None
         if coordinator is None:
             kv = None
-            if dist.is_multiprocess():
+            if cfg.kv_replicas:
+                # Quorum-replicated coordination plane (runtime/kvrep.py):
+                # the election, membership, masks, and lease all ride N
+                # independent backends; losing any minority of them is a
+                # survived hiccup instead of a dead control plane.
+                from ps_pytorch_tpu.runtime.kvrep import build_replicated_kv
+                kv = self._kvrep = build_replicated_kv(
+                    cfg, process_index=jax.process_index(),
+                    injector=self.injector)
+            elif dist.is_multiprocess():
                 from ps_pytorch_tpu.runtime.coordinator import DistributedKV
                 kv = DistributedKV()  # control plane over the coordination service
             elif (self.injector is not None and self.injector.has_kv_faults) \
@@ -222,6 +233,12 @@ class Trainer:
                 # snapshots on every render.
                 declare_resilience_metrics(self.registry)
                 collect.append(self._pump_resilience_metrics)
+            if self._kvrep is not None:
+                # Replication-plane health on the SAME scrape endpoint:
+                # quorum failures, ejections, rejoins, and the live
+                # healthy-backend gauge.
+                declare_kvrep_metrics(self.registry)
+                collect.append(self._pump_kvrep_metrics)
             self.exporter = MetricsExporter(
                 self.registry,
                 port=cfg.metrics_port + jax.process_index(),
@@ -320,6 +337,8 @@ class Trainer:
             out.update(self.injector.snapshot())
         if self._retrier is not None:
             out.update(self._retrier.snapshot())
+        if self._kvrep is not None:
+            out.update(self._kvrep.snapshot())
         if self.coordinator.liveness is not None:
             out.update(self.coordinator.liveness.snapshot())
         out["mask_changes"] = self.coordinator.stats.get("mask_changes", 0)
@@ -373,6 +392,22 @@ class Trainer:
                 continue            # snapshot key with no declared metric
             if delta > 0:
                 self.registry.inc(name, delta)
+
+    def _pump_kvrep_metrics(self) -> None:
+        """kvrep_* counters/gauges from the live ReplicatedKV — same
+        delta-inc discipline as the resilience pump."""
+        for name, value in self._kvrep.snapshot().items():
+            try:
+                delta = value - self.registry.get(name)
+            except KeyError:
+                continue
+            if delta > 0:
+                self.registry.inc(name, delta)
+        for name, value in self._kvrep.gauges().items():
+            try:
+                self.registry.set(name, value)
+            except KeyError:
+                continue
 
     def _health_status(self) -> dict:
         """/healthz body: watchdog state (stall evaluated on demand from the

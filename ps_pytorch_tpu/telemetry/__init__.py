@@ -20,13 +20,13 @@ from ps_pytorch_tpu.telemetry.prometheus import (  # noqa: F401
 )
 from ps_pytorch_tpu.telemetry.registry import (  # noqa: F401
     HIERARCHY_COUNTERS, HIERARCHY_GAUGES, INTEGRITY_COUNTERS,
-    INTEGRITY_GAUGES, RESILIENCE_COUNTERS,
+    INTEGRITY_GAUGES, KVREP_COUNTERS, KVREP_GAUGES, RESILIENCE_COUNTERS,
     SERVING_COUNTERS, SERVING_GAUGES,
     SERVING_HISTOGRAMS, TRAINING_COUNTERS, TRAINING_GAUGES,
     TRAINING_HISTOGRAMS, MetricSpec, Registry, aggregate_peak_flops,
     compute_mfu, data_stall_fraction, declare_elastic_metrics,
     declare_hierarchy_metrics, declare_integrity_metrics,
-    declare_resilience_metrics,
+    declare_kvrep_metrics, declare_resilience_metrics,
     declare_serving_metrics, declare_training_metrics, derive_step_record,
     device_memory_record, host_rss_bytes, step_flops_of,
 )

@@ -209,6 +209,12 @@ RESILIENCE_COUNTERS = (
     ("payload_truncates", "ops", "injected torn-read chunk truncations"),
     ("grad_poisons", "steps",
      "steps where an injected grad_poison window scaled local gradients"),
+    ("kv_backend_kills", "events",
+     "injected kv_backend_kill outage windows opened"),
+    ("kv_backend_wipes", "events",
+     "injected kv_backend_wipe keyspace losses fired"),
+    ("kv_backend_drops", "ops",
+     "single-backend ops dropped inside a kv_backend_kill window"),
 )
 
 
@@ -253,6 +259,45 @@ def declare_integrity_metrics(registry: Registry) -> Registry:
     for name, unit, help_ in INTEGRITY_COUNTERS:
         registry.counter(name, unit=unit, help=help_)
     for name, unit, help_ in INTEGRITY_GAUGES:
+        registry.gauge(name, unit=unit, help=help_)
+    return registry
+
+
+# ---- replicated-KV contract (ps_pytorch_tpu/runtime/kvrep.py) -------------
+#
+# The quorum-replicated coordination plane's reviewable surface: quorum
+# failures the retry plane saw, per-backend error/ejection/rejoin
+# lifecycle, steady-state read-repair traffic, and anti-entropy resync
+# volume — plus the two gauges a dashboard needs to see a degraded
+# replica set AT A GLANCE.
+KVREP_COUNTERS = (
+    ("kvrep_quorum_failures", "ops",
+     "logical KV ops that failed to reach a write/read quorum"),
+    ("kvrep_backend_errors", "ops",
+     "single-backend op failures absorbed below the quorum"),
+    ("kvrep_ejections", "events",
+     "backends ejected after consecutive failures"),
+    ("kvrep_rejoins", "events",
+     "ejected backends readmitted after probe + anti-entropy resync"),
+    ("kvrep_read_repairs", "ops",
+     "stale/absent replica copies overwritten during quorum reads"),
+    ("kvrep_resyncs", "events", "anti-entropy resync passes completed"),
+    ("kvrep_resync_keys", "keys",
+     "replica copies repaired by anti-entropy resync"),
+    ("kvrep_probes", "events", "probation probes sent to ejected backends"),
+)
+KVREP_GAUGES = (
+    ("kvrep_backends", "backends", "configured KV replica backends"),
+    ("kvrep_backends_healthy", "backends",
+     "KV replica backends currently in the quorum set"),
+)
+
+
+def declare_kvrep_metrics(registry: Registry) -> Registry:
+    """Declare the replicated-KV counters/gauges on ``registry``."""
+    for name, unit, help_ in KVREP_COUNTERS:
+        registry.counter(name, unit=unit, help=help_)
+    for name, unit, help_ in KVREP_GAUGES:
         registry.gauge(name, unit=unit, help=help_)
     return registry
 

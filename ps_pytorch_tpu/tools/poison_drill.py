@@ -58,13 +58,19 @@ def _worker(args) -> None:
     leader reads those keys). Retry attempts are kept low so a corrupted
     read demotes fast instead of stalling the poll loop.
 
-    EF is OFF here on purpose: sender-side error feedback on a poisoned
-    contributor re-emits the poison as a residual that decays ~128x per
-    step — several steps of validator-legal (|e| <= 64) but still-huge
-    payloads AFTER the window closes, i.e. a contributor that keeps
-    poisoning. The readmission arc needs the offender to actually go
-    clean when its window ends; persistent offenders are the quarantine's
-    steady-state job, not this drill's."""
+    EF is OFF in the main poison leg on purpose: sender-side error
+    feedback on a poisoned contributor re-emits the poison as a residual
+    that decays ~128x per step — several steps of validator-legal
+    (|e| <= 64) but still-huge payloads AFTER the window closes, i.e. a
+    contributor that keeps poisoning. The readmission arc needs the
+    offender to actually go clean when its window ends.
+
+    The ``--ef`` leg re-enables EF WITH the --ef-clip residual clamp
+    (compression/codecs.py): the absorbed poison is capped at a
+    ~clip-sized perturbation per leaf, so the offender still draws a
+    quarantine during its window but cannot keep smuggling huge
+    validator-legal payloads after it — the PR 13 documented gap
+    (PERF.md §17), closed and drilled."""
     from ps_pytorch_tpu.parallel import dist
     dist.initialize_from_env()
     import jax
@@ -76,7 +82,8 @@ def _worker(args) -> None:
         lr=0.05, momentum=0.9, compute_dtype="float32", mode="async",
         max_steps=args.max_steps, eval_freq=0, train_dir=args.train_dir,
         resume=False, log_every=4, seed=42,
-        compress_grad=True, grad_codec="int8lat", ef=False,
+        compress_grad=True, grad_codec="int8lat", ef=args.ef,
+        ef_clip=args.ef_clip if args.ef else 0.0,
         staleness_limit=4, kv_retry_attempts=2,
         grad_integrity=not args.no_integrity,
         fault_spec=args.fault_spec)
@@ -228,7 +235,8 @@ def _final_losses(logs):
     return out
 
 
-def _run_leg(base, name, args, fault_spec="", no_integrity=False):
+def _run_leg(base, name, args, fault_spec="", no_integrity=False,
+             ef=False):
     d = base / name
     import shutil
     shutil.rmtree(d, ignore_errors=True)
@@ -237,6 +245,8 @@ def _run_leg(base, name, args, fault_spec="", no_integrity=False):
                    "--fault-spec", fault_spec]
     if no_integrity:
         worker_args.append("--no-integrity")
+    if ef:
+        worker_args += ["--ef", "--ef-clip", str(args.ef_clip)]
     rc = _launch(d, _free_port(), worker_args)
     return rc, _logs(d)
 
@@ -248,6 +258,11 @@ def main(argv=None) -> int:
     ap.add_argument("--train-dir", default="")
     ap.add_argument("--fault-spec", default="")
     ap.add_argument("--no-integrity", action="store_true")
+    ap.add_argument("--ef", action="store_true",
+                    help="worker: sender-side error feedback ON (the EF x "
+                         "integrity composition leg)")
+    ap.add_argument("--ef-clip", type=float, default=1.0,
+                    help="per-leaf residual L2 cap for the --ef leg")
     ap.add_argument("--max-steps", type=int, default=40)
     # Poison window in process 2's OWN step clock: opens early (step 3)
     # and stays open 16 steps — enough leader screenings for 3 strikes —
@@ -324,6 +339,26 @@ def main(argv=None) -> int:
         print("\n\n".join(f"== proc_{i} ==\n{t[-3000:]}"
                           for i, t in enumerate(logs_p)))
 
+    # -- phase 2b: same poison with EF RE-ENABLED (+ --ef-clip) ---------
+    # The PR 13 gap: unclamped EF turned one poisoned window into many
+    # steps of validator-legal re-emission. With the residual clamp the
+    # offender must still be quarantined during its window, and the run
+    # must stay finite and complete — the composition is safe again.
+    rc_e, logs_e = _run_leg(base, "poison_ef", args,
+                            fault_spec=poison_spec, ef=True)
+    finals_e = _final_losses(logs_e)
+    quarantined_ef = re.search(
+        r"INTEGRITY quarantine contributor 2 at version (\d+)", logs_e[0])
+    p2b_ok = (rc_e != 2 and len(finals_e) == 4
+              and all(l == l for l in finals_e.values())
+              and quarantined_ef is not None)
+    print(f"PHASE poison_ef ok={p2b_ok} "
+          f"quarantined={bool(quarantined_ef)} finals={finals_e} "
+          f"ef_clip={args.ef_clip}")
+    if not p2b_ok:
+        print("\n\n".join(f"== proc_{i} ==\n{t[-3000:]}"
+                          for i, t in enumerate(logs_e)))
+
     # -- phase 3: same poison, screen OFF — must diverge ----------------
     rc_n, logs_n = _run_leg(base, "control", args, fault_spec=poison_spec,
                             no_integrity=True)
@@ -347,7 +382,7 @@ def main(argv=None) -> int:
     print(f"PHASE bench ok={p5_ok} overhead_frac={bench['overhead_frac']}")
 
     # -- artifact -------------------------------------------------------
-    ok = bool(p1_ok and p2_ok and p3_ok and p4["ok"] and p5_ok)
+    ok = bool(p1_ok and p2_ok and p2b_ok and p3_ok and p4["ok"] and p5_ok)
     art = {
         "round": 16,
         "platform": "cpu",
@@ -386,6 +421,11 @@ def main(argv=None) -> int:
                        "readmitted_at_version":
                            int(readmitted.group(1)) if readmitted else -1,
                        "per_process_stats": stats},
+            "poison_ef": {"ok": p2b_ok, "rc": rc_e, "finals": finals_e,
+                          "ef_clip": args.ef_clip,
+                          "quarantined_at_version":
+                              int(quarantined_ef.group(1))
+                              if quarantined_ef else -1},
             "control": {"ok": p3_ok, "rc": rc_n, "finals": finals_n,
                         "diverged": control_diverged},
             "bitwise": p4,

@@ -46,6 +46,18 @@ Families and their watched metrics (direction, relative tolerance):
                                         screened run's final loss matched
                                         the clean baseline, and the digest+
                                         screen overhead stayed < 2%
+- ``kvrep``      RESILIENCE_r*.json     newest artifact WITH a "kvrep"
+                                        section: the coordination-plane
+                                        drill (tools/kvrep_drill.py) saw a
+                                        KV backend actually SIGKILLed AND
+                                        wiped, every client rejoined and
+                                        anti-entropy-resynced it back to
+                                        key-by-key tag equality, training
+                                        finished with zero giveups, serving
+                                        availability held 1.00 with zero
+                                        5xx, the resume recurrence stayed
+                                        bitwise, and the wire-bench
+                                        replication overhead stayed < 5%
 
 Rows are matched by their "config" name — a config present in the baseline
 but missing from the candidate is a failure (silently dropping a bench row
@@ -178,6 +190,23 @@ FAMILIES: Dict[str, dict] = {
                           ("wire_integrity_failures", 1)],
         "absolute": [("overhead_frac", 0.02)],
     },
+    "kvrep": {
+        # Same artifact series, gating the coordination-plane drill
+        # (tools/kvrep_drill.py): the newest RESILIENCE_r*.json carrying a
+        # "kvrep" section must show a KV backend actually SIGKILLed and
+        # wiped with the quorum masking it end to end — training completed
+        # every version with zero retry giveups and the reborn backend
+        # resynced to key-by-key tag equality, fleet serving held
+        # availability 1.00 with zero client 5xx through the wipe, the
+        # restart-mid-outage recurrence stayed bitwise, and the wire-bench
+        # replication overhead stayed under the 5% budget.
+        "pattern": "RESILIENCE_r[0-9]*.json",
+        "metrics": [],              # invariant check, see _check_kvrep
+        "bools": ["bitwise_equal", "ok"],
+        "min_kvrep": [("backend_kills", 1), ("backend_wipes", 1),
+                      ("rejoins", 1), ("resyncs", 1)],
+        "absolute": [("overhead_frac", 0.05)],
+    },
 }
 
 
@@ -243,6 +272,8 @@ def compare(family: str, baseline, candidate) -> dict:
         return _check_router(spec, candidate)
     if family == "integrity":
         return _check_integrity(spec, candidate)
+    if family == "kvrep":
+        return _check_kvrep(spec, candidate)
     if family == "ops":
         return _check_ops(spec, candidate)
     if family == "slo":
@@ -539,6 +570,52 @@ def _check_integrity(spec: dict, candidate) -> dict:
             "configs": {"invariants": {"ok": ok, "metrics": checks}}}
 
 
+def _check_kvrep(spec: dict, candidate) -> dict:
+    doc = candidate if isinstance(candidate, dict) else \
+        (candidate[0] if candidate else {})
+    checks: Dict[str, dict] = {}
+    ok = True
+    kvrep = doc.get("kvrep")
+    if not isinstance(kvrep, dict):
+        return {"family": "kvrep", "ok": False,
+                "configs": {"invariants": {"ok": False, "metrics": {
+                    "_kvrep": {"ok": False,
+                               "note": "artifact has no kvrep section"}}}}}
+    for key in spec["bools"]:
+        if key in doc:
+            checks[key] = {"cand": doc[key], "ok": bool(doc[key])}
+            ok = ok and checks[key]["ok"]
+    for key, floor in spec["min_kvrep"]:
+        val = int(kvrep.get(key, 0))
+        checks[key] = {"cand": val, "floor": floor, "ok": val >= floor}
+        ok = ok and checks[key]["ok"]
+    # Training over the quorum: every version, zero giveups, and the
+    # reborn backend back to key-by-key tag equality.
+    train = kvrep.get("train", {})
+    giveups = int(train.get("giveups", -1))
+    checks["train_giveups"] = {"cand": giveups, "ok": giveups == 0}
+    ok = ok and checks["train_giveups"]["ok"]
+    teq = bool(train.get("resync_tag_equal", False))
+    checks["train_resync_tag_equal"] = {"cand": teq, "ok": teq}
+    ok = ok and teq
+    # Serving through the wipe: availability 1.00, zero client 5xx.
+    serve = kvrep.get("serve", {})
+    avail = float(serve.get("availability", 0.0))
+    floor = float(serve.get("availability_floor", 1.0))
+    checks["serve_availability"] = {"cand": avail, "floor": floor,
+                                    "ok": avail >= floor}
+    ok = ok and checks["serve_availability"]["ok"]
+    fxx = int(serve.get("failed_5xx", -1))
+    checks["serve_failed_5xx"] = {"cand": fxx, "ok": fxx == 0}
+    ok = ok and checks["serve_failed_5xx"]["ok"]
+    for metric, budget in spec["absolute"]:
+        val = float(kvrep.get("overhead", {}).get(metric, float("inf")))
+        checks[metric] = {"cand": val, "budget": budget, "ok": val < budget}
+        ok = ok and checks[metric]["ok"]
+    return {"family": "kvrep", "ok": ok,
+            "configs": {"invariants": {"ok": ok, "metrics": checks}}}
+
+
 def run_gate(family: str, candidate_path: str, repo: str = ".",
              baseline_path: str = "") -> dict:
     """Gate one candidate artifact against the newest committed baseline
@@ -548,7 +625,7 @@ def run_gate(family: str, candidate_path: str, repo: str = ".",
     candidate = load_artifact(candidate_path)
     baseline = None
     if family not in ("resilience", "ops", "slo", "wire_codec",
-                      "hierarchy", "router", "integrity"):
+                      "hierarchy", "router", "integrity", "kvrep"):
         if baseline_path:
             baseline = load_artifact(baseline_path)
         else:
@@ -579,7 +656,8 @@ def run_all(repo: str = ".") -> dict:
             families[family] = {"family": family, "ok": True,
                                 "note": "no committed artifacts; skipped"}
             continue
-        if family in ("elastic", "hierarchy", "router", "integrity"):
+        if family in ("elastic", "hierarchy", "router", "integrity",
+                      "kvrep"):
             # Gate the newest artifact that actually ran this drill
             # (older RESILIENCE rounds predate the subsystem).
             with_section = [p for p in paths if isinstance(

@@ -290,6 +290,28 @@ def collect(repo: str):
             "ok": (d.get("ok") is True
                    and int(integ.get("crashes", -1)) == 0
                    and bool(integ.get("control_diverged")))})
+    p = _newest_with_section("RESILIENCE_r[0-9]*.json", repo, "kvrep")
+    if p:
+        # Coordination-plane evidence (tools/kvrep_drill.py): a KV backend
+        # SIGKILLed then wiped with training completing on the quorum
+        # (zero giveups, reborn backend resynced to tag equality), serving
+        # holding availability 1.00 through the wipe, and the wire-bench
+        # replication overhead inside its 5% budget.
+        d = as_dict(_load(p))
+        kvrep = d.get("kvrep") or {}
+        train = kvrep.get("train") or {}
+        serve = kvrep.get("serve") or {}
+        add("coordination plane", p, {
+            "value": serve.get("availability"),
+            "unit": "availability under KV backend kill+wipe",
+            "platform": d.get("platform"),
+            "backends": d.get("backends"),
+            "overhead_frac": (kvrep.get("overhead") or {}).get(
+                "overhead_frac"),
+            "ok": (d.get("ok") is True
+                   and int(train.get("giveups", -1)) == 0
+                   and bool(train.get("resync_tag_equal"))
+                   and int(serve.get("failed_5xx", -1)) == 0)})
     p = _newest("BENCH_WIRE_r[0-9]*.json", repo)
     if p:
         # Wire-overlap evidence (bench_suite wire_blocking_*/wire_overlapped_*

@@ -22,7 +22,10 @@ loop; ``tools/router.py``-less fleets just point the Router's FleetView at
 the same dir. SIGTERM triggers graceful drain (stop admitting, finish
 in-flight, deregister, exit) — the zero-downtime half of a rolling
 restart. ``--fault-spec "replica_kill:served=20,r=<i>"`` arms the
-drill's SIGKILL.
+drill's SIGKILL. With ``--kv-replicas <spec,...>`` the registry rides the
+quorum-replicated coordination plane (``runtime/kvrep.py``) instead of a
+single directory, so losing a minority of KV backends never blinds the
+router.
 """
 
 import argparse
@@ -118,7 +121,18 @@ def main(argv=None) -> int:
     # Fleet plane: registrar (KV record + liveness lease, beaten by the
     # serve loop) and the replica_kill fault injector for the drill.
     registrar = None
-    if args.serve_kv_dir:
+    if args.kv_replicas:
+        # Quorum-replicated fleet registry: the replica record + liveness
+        # lease survive loss of a minority of KV backends, so the router
+        # never loses its fleet view to a single dead store.
+        from ps_pytorch_tpu.runtime.kvrep import build_replicated_kv
+        from ps_pytorch_tpu.serving.router import FleetRegistrar
+        fleet_kv = build_replicated_kv(
+            args, process_index=args.serve_replica_id)
+        registrar = FleetRegistrar(fleet_kv, args.serve_fleet,
+                                   args.serve_replica_id)
+        identity["replica_id"] = args.serve_replica_id
+    elif args.serve_kv_dir:
         from ps_pytorch_tpu.runtime.coordinator import FileKV
         from ps_pytorch_tpu.serving.router import FleetRegistrar
         registrar = FleetRegistrar(FileKV(args.serve_kv_dir),

@@ -329,6 +329,25 @@ def test_error_feedback_recovers_dropped_mass():
     assert ef.residual_nbytes() == g.nbytes
 
 
+def test_error_feedback_clip_bounds_residual_norm():
+    """--ef-clip caps the carried residual's L2 norm per leaf: a poisoned
+    step can smuggle at most ~clip through the validator-legal band, while
+    honest residuals (far below any sane clip) pass through untouched."""
+    ef = ErrorFeedback(clip=0.5)
+    big = np.full(100, 10.0, np.float32)            # ||r|| = 100
+    ef.update(0, big, np.zeros_like(big))
+    assert np.linalg.norm(ef._r[0]) == pytest.approx(0.5, rel=1e-5)
+    # Direction preserved — only the magnitude is clamped.
+    assert np.all(ef._r[0] > 0) and ef._r[0].dtype == np.float32
+    small = np.full(100, 1e-4, np.float32)          # ||r|| = 1e-3 << clip
+    ef.update(1, small, np.zeros_like(small))
+    np.testing.assert_array_equal(ef._r[1], small)
+    # clip=0 (default) is the legacy unclamped behaviour, bit for bit.
+    ef0 = ErrorFeedback()
+    ef0.update(0, big, np.zeros_like(big))
+    np.testing.assert_array_equal(ef0._r[0], big)
+
+
 def test_error_feedback_state_roundtrip_bitwise():
     rng = np.random.default_rng(5)
     ef = ErrorFeedback()
