@@ -1373,6 +1373,72 @@ def bench_kvrep_overhead(name, steps, *, payload_mb=24, leaf_kb=1024,
             "overhead_frac": round(frac, 5), "ok": frac < 0.05}
 
 
+def bench_zero(name, steps, *, n_shards=2, payload_mb=24, leaf_kb=1024,
+               optimizer="sgd", workers=4, rtt_ms=2.0):
+    """ZeRO-over-the-wire row (ISSUE 15, parallel/zero_wire.py): N single-
+    shard-owner ZeroWireUpdater instances drive the SAME deterministic
+    gradient stream over one LatencyKV. n_shards=1 IS the replicated
+    baseline — the one owner applies the full update and publishes the
+    full param pytree, exactly what the monolithic canonical publish
+    shipped. Each row records the per-replica wire bytes (max over
+    members: the sharded owner publishes 1/N of the tree), the
+    publish/assemble walls, the per-replica optimizer-state footprint
+    (~1/N — the memory claim), and a sha256 of the final assembled
+    params; main() derives zero_wire_win_* rows asserting the sharded
+    run is BITWISE identical to the replicated one while cutting both
+    per-replica publish bytes and optimizer memory."""
+    import hashlib
+
+    from ps_pytorch_tpu.parallel.zero_wire import ZeroWireUpdater
+    from ps_pytorch_tpu.runtime.coordinator import KVStore
+
+    n_leaves = max(int(payload_mb * 1024 // leaf_kb), 1)
+    per_leaf = int(leaf_kb * 1024 // 4)
+    rng = np.random.default_rng(0)
+    tree = {f"l{i:04d}": rng.normal(size=(per_leaf,))
+            .astype(np.float32) / 4.0 for i in range(n_leaves)}
+    opt_kw = dict(lr=0.05, momentum=0.9) if optimizer == "sgd" \
+        else dict(lr=1e-3)
+    kv = LatencyKV(KVStore(), rtt_ms / 1e3)
+    members = list(range(n_shards))
+    ups = [ZeroWireUpdater(inner=None, kv=kv, run_id="bench/zw", params=tree,
+                           optimizer=optimizer, members=members, me=m,
+                           n_shards=n_shards, workers=workers, **opt_kw)
+           for m in members]
+    rounds = max(min(steps, 5), 2)
+    publish_s = assemble_s = 0.0
+    grng = np.random.default_rng(1)
+    full = None
+    for rnd in range(rounds):
+        g = {k: grng.normal(size=v.shape).astype(np.float32) / 8.0
+             for k, v in tree.items()}
+        t0 = time.perf_counter()
+        for u in ups:                   # each member: update + publish 1/N
+            u.apply_and_publish(g, version=rnd + 1)
+        t1 = time.perf_counter()
+        trees = [u.assemble_round() for u in ups]
+        assemble_s += time.perf_counter() - t1
+        publish_s += t1 - t0
+        full = trees[0]
+    h = hashlib.sha256()
+    for k in sorted(full):
+        h.update(np.ascontiguousarray(full[k], np.float32).tobytes())
+    out_mb = [u.wire_stats()["zw_bytes_out"] / 1e6 for u in ups]
+    in_mb = [u.wire_stats()["zw_bytes_in"] / 1e6 for u in ups]
+    opt_mb = [u.opt_state_nbytes() / 1e6 for u in ups]
+    return {"config": name, "platform": "host", "payload_mb": payload_mb,
+            "leaves": n_leaves, "optimizer": optimizer, "shards": n_shards,
+            "workers": workers, "rtt_ms": rtt_ms, "rounds": rounds,
+            "wire_out_mb_max": round(max(out_mb), 3),
+            "wire_out_mb_mean": round(sum(out_mb) / len(out_mb), 3),
+            "wire_in_mb_max": round(max(in_mb), 3),
+            "publish_s": round(publish_s / rounds, 4),
+            "assemble_s": round(assemble_s / rounds, 4),
+            "total_s": round((publish_s + assemble_s) / rounds, 4),
+            "opt_state_mb_max": round(max(opt_mb), 3),
+            "params_sha256": h.hexdigest()}
+
+
 CONFIGS = {
     "lenet_mnist_single": lambda steps: bench_throughput(
         "lenet_mnist_single", "LeNet", "synthetic_mnist", 128, steps,
@@ -1549,6 +1615,18 @@ CONFIGS = {
     "hier_sync_9slice": lambda steps: bench_hier_agg(
         "hier_sync_9slice", min(steps, 2), n_slices=9, group_size=3,
         payload_mb=4),
+    # -- ZeRO-over-the-wire (ISSUE 15, parallel/zero_wire.py): sharded
+    # weight update on the KV plane. The 1shard row IS the replicated
+    # baseline (one owner, full-pytree publish); main() derives
+    # zero_wire_win_* from each N-shard row vs it — acceptance: bitwise-
+    # identical final params, per-replica publish bytes <= 0.75x the
+    # full-pytree publish, optimizer state ~1/N per replica. --
+    "zero_wire_1shard": lambda steps: bench_zero(
+        "zero_wire_1shard", steps, n_shards=1),
+    "zero_wire_2shard": lambda steps: bench_zero(
+        "zero_wire_2shard", steps, n_shards=2),
+    "zero_wire_4shard": lambda steps: bench_zero(
+        "zero_wire_4shard", steps, n_shards=4),
 }
 
 
@@ -1715,6 +1793,43 @@ def main(argv=None) -> int:
                           and row["rel_err"] < 0.05)}
         print(json.dumps(out), flush=True)
         rows.append(out)
+
+    # ZeRO-over-the-wire: each N-shard row vs the 1shard replicated
+    # baseline at the same geometry/RTT/grad stream. The three claims the
+    # derived row certifies: (1) the sharded update is BITWISE identical
+    # to the replicated one (same final-params sha256 — disjoint-slice
+    # float32 ops are IEEE-identical to the full-vector ops), (2) the
+    # per-replica publish bytes drop to ~1/N of the full-pytree publish,
+    # (3) the per-replica optimizer state drops to ~1/N.
+    zbase = next((r for r in rows if r.get("config") == "zero_wire_1shard"
+                  and "error" not in r), None)
+    if zbase:
+        for row in list(rows):
+            cfg_name = row.get("config", "")
+            if not cfg_name.startswith("zero_wire_") or "error" in row \
+                    or row is zbase or cfg_name.startswith("zero_wire_win"):
+                continue
+            n = row["shards"]
+            wire_ratio = row["wire_out_mb_max"] / \
+                max(zbase["wire_out_mb_max"], 1e-9)
+            opt_ratio = row["opt_state_mb_max"] / \
+                max(zbase["opt_state_mb_max"], 1e-9)
+            bitwise = (row["params_sha256"] == zbase["params_sha256"])
+            out = {"config": f"zero_wire_win_{n}shard",
+                   "shards": n,
+                   "baseline_wire_out_mb": zbase["wire_out_mb_max"],
+                   "wire_out_mb_max": row["wire_out_mb_max"],
+                   "wire_out_ratio": round(wire_ratio, 3),
+                   "baseline_opt_state_mb": zbase["opt_state_mb_max"],
+                   "opt_state_mb_max": row["opt_state_mb_max"],
+                   "opt_state_ratio": round(opt_ratio, 3),
+                   "baseline_total_s": zbase["total_s"],
+                   "total_s": row["total_s"],
+                   "bitwise_identical": bitwise,
+                   "ok": bool(bitwise and wire_ratio <= 0.75
+                              and opt_ratio <= 1.0 / n + 0.15)}
+            print(json.dumps(out), flush=True)
+            rows.append(out)
 
     # Serving: batched (8 slots) vs sequential (1 slot) aggregate
     # tokens/sec at 8 concurrent requests, AND the two runs' sampled tokens

@@ -68,6 +68,7 @@ class TrainConfig:
     model_axis: int = 1              # reserved mesh axis for TP (unused by these models)
     sync_batchnorm: bool = False     # reference keeps BN stats worker-local (distributed_worker.py:245-252)
     shard_update: bool = False       # ZeRO-1 cross-replica sharded weight update (parallel/zero.py)
+    shard_wire: bool = False         # ZeRO-over-the-wire: sharded weight update on the KV plane (parallel/zero_wire.py; async mode, flat topology)
 
     # -- hierarchical sync (parallel/hierarchy.py: 2-tier multi-hop
     #    aggregation over the coordination KV; flat = the star topology) --
@@ -375,6 +376,41 @@ class TrainConfig:
         if self.hier_hop_retries < 1:
             raise ValueError(f"hier_hop_retries={self.hier_hop_retries} "
                              "(must be >= 1; 1 = no retries)")
+        if self.shard_wire:
+            # --shard-wire holds a bitwise guarantee (sharded update ==
+            # replicated update, exactly). Reject at config time every
+            # combination that cannot certify it, one clear message each.
+            if self.shard_update:
+                raise ValueError(
+                    "--shard-wire and --shard-update are two homes for the "
+                    "SAME ZeRO-1 state split: across KV replicas vs across "
+                    "the in-mesh data axis. Nesting them would shard "
+                    "already-sharded optimizer state; pick one.")
+            if self.mode != "async":
+                raise ValueError(
+                    f"--shard-wire shards the weight update on the async KV "
+                    f"plane; mode={self.mode!r} has no KV update path. Use "
+                    f"--mode async, or --shard-update for the in-mesh "
+                    f"(sync/kofn) form.")
+            if self.sync_topology == "hier":
+                raise ValueError(
+                    "--shard-wire requires sync_topology=flat: hierarchical "
+                    "multi-hop re-weighting aggregates per tier, so the "
+                    "per-shard update could not be certified bitwise-equal "
+                    "to the replicated update.")
+            if self.compress_grad and self.grad_codec == "int8":
+                raise ValueError(
+                    "--shard-wire cannot use grad_codec=int8: its on-device "
+                    "Pallas dequantize keeps per-contributor payloads "
+                    "device-resident, while the sharded update is applied "
+                    "host-side. Use blosc or a homomorphic codec "
+                    "(int8lat | topk | randk); --ef composes fine.")
+            if self.lr_schedule != "constant":
+                raise ValueError(
+                    f"--shard-wire supports lr_schedule=constant only (got "
+                    f"{self.lr_schedule!r}): the host-side sharded optimizer "
+                    f"pins the float32 step size; a jitted schedule would "
+                    f"break the bitwise sharded==replicated guarantee.")
         if self.mode == "async" and self.publish_every > max(self.staleness_limit, 1):
             # Followers only ever see published versions: a publish gap
             # wider than the staleness window makes EVERY follower gradient

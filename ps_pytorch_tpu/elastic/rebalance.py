@@ -1,305 +1,27 @@
-"""ZeRO shard-plan rebalancing on membership change.
+"""ZeRO shard-plan rebalancing on membership change — compat re-export.
 
-``parallel/zero.py`` shards the weight update 1/n per replica with the
-scheme ``chunk = ceil(size / n)``, flat parameter vector padded to
-``chunk * n``. That n is baked into the compiled step — fine while the
-mesh is fixed, but an ELASTIC membership changes n mid-run. This module
-owns the host-side answer:
+The flat-vector primitives that used to live here (:class:`ShardPlan`,
+:func:`plan_shards`, :func:`reslice`, :class:`ShardedKVUpdate`) moved to
+``parallel/zero_wire.py`` so the elastic path and the ``--shard-wire``
+sharded-update aggregator share ONE ZeRO-over-KV implementation (one shard
+codec, one plan machinery, one wire-byte accounting). Along with the move,
+shard payloads switched from stdlib base64 to the vectorized armored
+base85 in ``utils/armor.py`` (~50x encode throughput, bit-pinned to the
+stdlib alphabet) and shard bytes now count into ``counters`` /
+``wire_stats()``.
 
-- :func:`plan_shards` reproduces zero.py's chunking exactly as an
-  explicit plan (contiguous [start, stop) bounds over the flat vector,
-  the same greedy-contiguous partition idiom as parallel/buckets.py), so
-  the device path and the elastic path can never disagree about who owns
-  which slice.
-- :func:`reslice` moves shard state between two plans: concatenate the
-  old shards (unpad), re-cut at the new bounds. Pure array surgery — no
-  arithmetic touches the values, so rebalancing is bitwise-neutral by
-  construction.
-- :class:`ShardedKVUpdate` is the cross-process form: each member owns
-  one shard of params + optimizer state, publishes raw little-endian
-  bytes through the coordination KV (lossless — no text round-trip), and
-  on a membership change redistributes every shard through the KV under
-  the next plan epoch. The update itself is the reference-exact SGD
-  (+momentum) recurrence applied per element; elementwise updates on
-  disjoint slices are THE SAME floating-point operations as on the full
-  vector, so the sharded run equals the replicated run bit-for-bit at
-  every N and across every rebalance — asserted, not assumed, by
-  tests/test_elastic.py and the multi-process drill.
+This module keeps the old import surface alive for callers and tests.
 """
 
-import base64
-import json
-import time
-from dataclasses import dataclass
-from typing import Callable, Dict, List, Optional, Tuple
-
-import numpy as np
+from ps_pytorch_tpu.parallel.zero_wire import (  # noqa: F401
+    ShardPlan,
+    ShardedKVUpdate,
+    plan_shards,
+    reslice,
+)
+from ps_pytorch_tpu.parallel.zero_wire import (  # noqa: F401
+    decode_array as _decode,
+    encode_array as _encode,
+)
 
 __all__ = ["ShardPlan", "plan_shards", "reslice", "ShardedKVUpdate"]
-
-
-@dataclass(frozen=True)
-class ShardPlan:
-    """Contiguous equal-chunk partition of a flat vector of ``size``
-    elements over ``n`` shards (zero.py's scheme, made explicit)."""
-    size: int
-    n: int
-    chunk: int
-    bounds: Tuple[Tuple[int, int], ...]  # [start, stop) in UNPADDED coords
-
-    @property
-    def padded(self) -> int:
-        return self.chunk * self.n
-
-    def shard_of(self, index: int) -> Tuple[int, int]:
-        return self.bounds[index]
-
-
-def plan_shards(size: int, n: int) -> ShardPlan:
-    """chunk = ceil(size/n); shard k owns [k*chunk, min((k+1)*chunk, size)).
-    Trailing shards may be empty when n is large — valid, they just carry
-    no state (zero.py's padding slots)."""
-    if size <= 0 or n <= 0:
-        raise ValueError(f"plan_shards needs size>0, n>0 (got {size}, {n})")
-    chunk = -(-size // n)
-    bounds = tuple((min(k * chunk, size), min((k + 1) * chunk, size))
-                   for k in range(n))
-    return ShardPlan(size=size, n=n, chunk=chunk, bounds=bounds)
-
-
-def reslice(old_plan: ShardPlan, new_plan: ShardPlan,
-            shards: List[np.ndarray]) -> List[np.ndarray]:
-    """Re-cut ``shards`` (one array per old shard, unpadded lengths) at the
-    new plan's bounds. Concatenation + slicing only: the values are moved,
-    never recomputed, so the full vector is invariant bit-for-bit."""
-    if old_plan.size != new_plan.size:
-        raise ValueError(f"plans disagree on size: {old_plan.size} vs "
-                         f"{new_plan.size}")
-    full = np.concatenate([np.asarray(s) for s in shards]) if shards \
-        else np.zeros(0)
-    if full.size != old_plan.size:
-        raise ValueError(f"shards hold {full.size} elements, plan says "
-                         f"{old_plan.size}")
-    return [full[lo:hi] for lo, hi in new_plan.bounds]
-
-
-def _encode(a: np.ndarray) -> str:
-    return base64.b64encode(np.ascontiguousarray(a).tobytes()).decode()
-
-
-def _decode(s: str, dtype) -> np.ndarray:
-    return np.frombuffer(base64.b64decode(s), dtype=dtype).copy()
-
-
-class ShardedKVUpdate:
-    """Host-side elastic ZeRO-1 update over the coordination KV.
-
-    Every member holds: its shard of the float32 parameter vector and the
-    matching momentum slice. Per round, each member applies the
-    reference-exact SGD recurrence to its slice of the (already averaged)
-    full gradient and publishes the updated slice; everyone assembles the
-    full vector from the published slices. ``set_members`` redistributes
-    params + momentum through the KV when the member set changes —
-    publish-old-shards / assemble / re-cut — bumping the plan epoch so
-    slices from different plans can never be mixed.
-
-    Keys: ``{run}/shard/{epoch}/p/{k}/{round}`` (params) and a one-shot
-    ``{run}/shard/{epoch}/m/{k}`` (momentum, written at redistribution
-    time only — steady-state rounds ship params only, exactly the
-    all-gather half of the ring).
-    """
-
-    def __init__(self, kv, run_id: str, size: int, members: List[int],
-                 me: int, lr: float, momentum: float = 0.0,
-                 timeout_s: float = 30.0,
-                 sleep: Optional[Callable[[float], None]] = None,
-                 poll_s: float = 0.002):
-        self.kv = kv
-        self.run_id = run_id
-        self.size = int(size)
-        self.me = int(me)
-        self.lr = np.float32(lr)
-        self.momentum = np.float32(momentum)
-        self.timeout_s = float(timeout_s)
-        self.sleep = sleep or time.sleep
-        self.poll_s = float(poll_s)
-        self.epoch = 1
-        self.members = sorted(int(m) for m in members)
-        self.plan = plan_shards(self.size, len(self.members))
-        self.round = 0
-        self._params: Optional[np.ndarray] = None  # my slice, float32
-        self._mom: Optional[np.ndarray] = None
-        self.counters: Dict[str, int] = {"rebalances": 0, "rounds": 0}
-
-    # ---- identity ----
-    @property
-    def shard_index(self) -> int:
-        return self.members.index(self.me)
-
-    def _span(self) -> Tuple[int, int]:
-        return self.plan.shard_of(self.shard_index)
-
-    # ---- lifecycle ----
-    def init(self, flat_params: np.ndarray) -> None:
-        """Everyone starts from the same full float32 vector (the
-        checkpoint / broadcast params) and keeps only its slice."""
-        flat = np.asarray(flat_params, np.float32)
-        if flat.size != self.size:
-            raise ValueError(f"params size {flat.size} != plan {self.size}")
-        lo, hi = self._span()
-        self._params = flat[lo:hi].copy()
-        self._mom = np.zeros(hi - lo, np.float32)
-
-    def _key(self, kind: str, shard: int, rnd: Optional[int] = None,
-             epoch: Optional[int] = None) -> str:
-        e = self.epoch if epoch is None else epoch
-        base = f"{self.run_id}/shard/{e}/{kind}/{shard}"
-        return base if rnd is None else f"{base}/{rnd}"
-
-    def _await(self, key: str) -> str:
-        waited = 0.0
-        while True:
-            v = self.kv.get(key)
-            if v is not None:
-                return v
-            if waited > self.timeout_s:
-                raise TimeoutError(f"shard key {key} never published")
-            self.sleep(self.poll_s)
-            waited += self.poll_s
-
-    # ---- the update round (publish / assemble halves of the gather) ----
-    def publish(self, grad: np.ndarray) -> None:
-        """Apply this member's slice of the update and publish it.
-        ``grad`` is the full averaged gradient (each member already has
-        it — the data-parallel reduce happened upstream).
-
-        SGD recurrence (reference optim/sgd.py, elementwise):
-            m <- momentum * m + g ; p <- p - lr * m
-        """
-        if self._params is None:
-            raise RuntimeError("call init() before publish()")
-        g = np.asarray(grad, np.float32)
-        lo, hi = self._span()
-        gs = g[lo:hi]
-        if self.momentum > 0:
-            self._mom = self.momentum * self._mom + gs
-            upd = self._mom
-        else:
-            upd = gs
-        self._params = self._params - self.lr * upd
-        self.kv.set(self._key("p", self.shard_index, self.round),
-                    _encode(self._params))
-
-    def assemble(self) -> np.ndarray:
-        """Block until every shard of the current round is published and
-        return the full updated parameter vector (the all-gather half)."""
-        full = np.empty(self.size, np.float32)
-        for k, (slo, shi) in enumerate(self.plan.bounds):
-            if slo == shi:
-                continue
-            if k == self.shard_index:
-                full[slo:shi] = self._params
-            else:
-                full[slo:shi] = _decode(
-                    self._await(self._key("p", k, self.round)), np.float32)
-        # GC the previous round's slice (bounded KV footprint).
-        if self.round > 0:
-            self.kv.delete(self._key("p", self.shard_index, self.round - 1))
-        self.round += 1
-        self.counters["rounds"] += 1
-        return full
-
-    def step(self, grad: np.ndarray) -> np.ndarray:
-        """publish + assemble. Safe when every member runs concurrently
-        (multi-process); single-threaded drivers interleaving several
-        members must publish ALL before assembling ANY or the await
-        deadlocks — the same constraint as the collective it mirrors."""
-        self.publish(grad)
-        return self.assemble()
-
-    # ---- rebalance (handoff / adopt halves of the redistribution) ----
-    def handoff(self, members: List[int]) -> bool:
-        """First half of a rebalance: every CURRENT member publishes its
-        params + momentum shard under the NEXT epoch. Returns False when
-        the member set is unchanged (no rebalance needed)."""
-        new = sorted(int(m) for m in members)
-        if new == self.members:
-            return False
-        if self.me in self.members and self._params is not None:
-            k = self.members.index(self.me)
-            next_epoch = self.epoch + 1
-            self.kv.set(self._key("p", k, None, next_epoch),
-                        _encode(self._params))
-            self.kv.set(self._key("m", k, None, next_epoch),
-                        _encode(self._mom))
-        return True
-
-    def adopt(self, members: List[int]) -> bool:
-        """Second half: assemble the full params + momentum from the old
-        plan's handoff keys and keep the slice the NEW plan assigns this
-        member. A leaver (not in the new set) goes dormant; a joiner (not
-        in the old set) only assembles. Bitwise-neutral: values are moved,
-        never recomputed (:func:`reslice` semantics over the KV)."""
-        new = sorted(int(m) for m in members)
-        if new == self.members:
-            return False
-        old_plan = self.plan
-        next_epoch = self.epoch + 1
-        if self.me not in new:
-            self.members, self.epoch = new, next_epoch
-            self.plan = plan_shards(self.size, len(new))
-            self._params = self._mom = None
-            self.counters["rebalances"] += 1
-            return True
-        fullp = np.empty(self.size, np.float32)
-        fullm = np.empty(self.size, np.float32)
-        for k, (slo, shi) in enumerate(old_plan.bounds):
-            if slo == shi:
-                continue
-            fullp[slo:shi] = _decode(
-                self._await(self._key("p", k, None, next_epoch)), np.float32)
-            fullm[slo:shi] = _decode(
-                self._await(self._key("m", k, None, next_epoch)), np.float32)
-        self.members, self.epoch = new, next_epoch
-        self.plan = plan_shards(self.size, len(new))
-        lo, hi = self._span()
-        self._params = fullp[lo:hi].copy()
-        self._mom = fullm[lo:hi].copy()
-        self.round = 0
-        self.counters["rebalances"] += 1
-        return True
-
-    def set_members(self, members: List[int]) -> bool:
-        """handoff + adopt. Members must run this collectively with the
-        same argument — concurrently across processes, or handoff-all
-        then adopt-all when a single thread drives several members (the
-        same discipline as publish/assemble)."""
-        if not self.handoff(members):
-            return False
-        return self.adopt(members)
-
-    # ---- reference (exactness oracle) ----
-    @staticmethod
-    def replicated_reference(flat_params: np.ndarray, grads: List[np.ndarray],
-                             lr: float, momentum: float = 0.0) -> np.ndarray:
-        """The same recurrence on the FULL vector — what every replica
-        would do without sharding. The exactness guard asserts the sharded
-        path equals this bitwise at every round and across rebalances."""
-        p = np.asarray(flat_params, np.float32).copy()
-        m = np.zeros_like(p)
-        lr32, mu32 = np.float32(lr), np.float32(momentum)
-        for g in grads:
-            g = np.asarray(g, np.float32)
-            if mu32 > 0:
-                m = mu32 * m + g
-                upd = m
-            else:
-                upd = g
-            p = p - lr32 * upd
-        return p
-
-    def snapshot(self) -> Dict[str, int]:
-        out = dict(self.counters)
-        out["epoch"] = self.epoch
-        out["n_shards"] = len(self.members)
-        return out

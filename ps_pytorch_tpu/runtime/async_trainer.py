@@ -117,6 +117,11 @@ class AsyncTrainer:
         # KV without knowing either layer exists.
         kv, self.injector, self._retrier = resilience.wrap_kv_with(
             kv, cfg, injector)
+        # --shard-wire (parallel/zero_wire.py) publishes per-shard params
+        # through this same hardened KV; keep the handle.
+        self._kv = kv
+        self._zw_rd = None           # lazy reader-mode updater (followers)
+        self._zw_ptr_version = -1    # last version whose shards are on the KV
         # Elastic control plane (--elastic): the PS-leader role becomes a
         # lease over the coordination KV instead of the pid==0 birthright.
         # The initial leader is --elastic-leader (keep it OFF process 0 in
@@ -189,7 +194,12 @@ class AsyncTrainer:
         # evaluator scores the master's checkpoint, which includes whatever
         # BN stats the checkpointing worker had).
         self._bs0 = lambda: jax.tree.map(lambda a: a[0], self._bs)
-        param_template = {"params": self.params, "bs0": self._bs0()}
+        # Under --shard-wire the canonical params travel as per-shard zw
+        # keys (pipelined, GC'd per round) instead of one monolithic
+        # transport publish — only the (small) BN stats keep riding the
+        # transport's param channel. That asymmetry IS the wire win.
+        param_template = {"bs0": self._bs0()} if cfg.shard_wire \
+            else {"params": self.params, "bs0": self._bs0()}
         # Overlapped wire (--wire-bucket-mb/--wire-workers): the channels
         # sync+encode+put bucket k while bucket k+1 is still on device, so
         # publish cost hides under the tail of backward instead of landing
@@ -338,18 +348,35 @@ class AsyncTrainer:
             # Homomorphic wire: the pool holds PAYLOADS (submit_encoded)
             # and collect() sums them in the compressed domain. EF stays
             # sender-side — each process compensates its own encodes.
-            return StaleGradientAggregator(
+            return self._wrap_shard_wire(StaleGradientAggregator(
                 self.n, staleness_limit=cfg.staleness_limit,
                 staleness_decay=cfg.staleness_decay,
                 num_aggregate=cfg.num_aggregate, compress=True,
                 codec=cfg.grad_codec, topk_frac=cfg.grad_topk_frac,
-                integrity=self._integrity)
-        return StaleGradientAggregator(
+                integrity=self._integrity))
+        agg = StaleGradientAggregator(
             self.n, staleness_limit=cfg.staleness_limit,
             staleness_decay=cfg.staleness_decay,
             num_aggregate=cfg.num_aggregate,
             compress=False,  # the WIRE is compressed; the pool is local
             integrity=self._integrity)
+        return self._wrap_shard_wire(agg)
+
+    def _wrap_shard_wire(self, agg):
+        """--shard-wire: wrap the leader pool in the sharded-update
+        aggregator (parallel/zero_wire.py). Pooling/staleness/K-of-N/
+        integrity delegate to ``agg`` untouched; the update itself runs
+        host-side per bucket-edge-snapped shard and publishes per-shard
+        params over the KV. Single-owner here (the leader owns every
+        shard); the bench exercises the symmetric multi-owner topology."""
+        cfg = self.cfg
+        if not cfg.shard_wire:
+            return agg
+        from ps_pytorch_tpu.parallel.zero_wire import updater_from_config
+        return updater_from_config(
+            cfg, inner=agg, kv=self._kv, run_id=f"zw-{cfg.seed}",
+            params=self.params, members=[0], me=0,
+            n_shards=max(self.n, 2))
 
     def _pump_resilience_metrics(self) -> None:
         """Refresh resilience counters from the live fault/retry snapshots
@@ -495,6 +522,12 @@ class AsyncTrainer:
         # zero residual, like a freshly relaunched reference worker).
         extra_state = {"ef": self._ef.state_dict()} \
             if (self.cfg.ef and self._ef is not None) else None
+        if self.cfg.shard_wire and self.leader:
+            # Sharded optimizer moments + step: without them a resumed /
+            # promoted leader restarts momentum from zero and diverges
+            # from the uninterrupted run.
+            extra_state = dict(extra_state or {})
+            extra_state["zero"] = self.aggregator.state_dict()
         ckpt.save_checkpoint(self.cfg.train_dir, self.version,
                              jax.device_get(self._as_train_state()),
                              config_json=self.cfg.to_json(),
@@ -524,6 +557,13 @@ class AsyncTrainer:
             from ps_pytorch_tpu.compression.codecs import ErrorFeedback
             self._ef = ErrorFeedback(clip=self.cfg.ef_clip)
             self._ef.load_state_dict(extra["ef"])
+        if self.cfg.shard_wire and self.leader:
+            # Bit-for-bit resume: re-anchor owned shards on the restored
+            # params, then restore the sharded moments + step.
+            self.aggregator.reset_params(self.params)
+            if extra and "zero" in extra:
+                self.aggregator.load_state_dict(extra["zero"])
+            self._zw_ptr_version = -1  # republish shards at this version
         print(f"RESUME from {ckpt.checkpoint_path(self.cfg.train_dir, step)} "
               f"at step {self.version}")
         return True
@@ -631,10 +671,21 @@ class AsyncTrainer:
         # (publish_every vs eval_freq); prefer the freshest params even
         # though the momentum then lags a few steps — async staleness
         # semantics already tolerate exactly that skew.
-        got = self.transport.fetch_params()
+        got = self._fetch_canonical(self.version)
         if got is not None and got[0] > self.version:
             self.version = got[0]
             self.params = jax.device_put(got[1]["params"], self._rep)
+        if cfg.shard_wire:
+            # The freshly built sharded updater re-anchors on the adopted
+            # params; the dead leader's sharded optimizer moments survive
+            # through its last checkpoint (same lag tolerance as above).
+            self.aggregator.reset_params(self.params)
+            step = ckpt.latest_step(cfg.train_dir)
+            extra = ckpt.load_extra_state(cfg.train_dir, step) \
+                if step is not None else None
+            if extra and "zero" in extra:
+                self.aggregator.load_state_dict(extra["zero"])
+            self._zw_ptr_version = -1  # force a full shard publish below
         self.leader = True
         print(f"ELECTED async leader process {self.pid} epoch "
               f"{self.election.epoch} at version {self.version} "
@@ -674,11 +725,50 @@ class AsyncTrainer:
     # ---- the two roles ----
     def _publish_canonical(self) -> None:
         t0 = time.monotonic()
-        payload = {"params": self.params, "bs0": self._bs0()}
+        if self.cfg.shard_wire:
+            # Params go out as per-shard zw keys; steady-state updates
+            # already published them inside update_from, so only publish
+            # here when the KV pointer lags (startup / resume / promote /
+            # final). The transport channel keeps just the BN stats.
+            if self._zw_ptr_version != self.version:
+                self.aggregator.publish_full(self.version)
+                self._zw_ptr_version = self.version
+            payload = {"bs0": self._bs0()}
+        else:
+            payload = {"params": self.params, "bs0": self._bs0()}
         if not self._wire_overlap:
             payload = jax.device_get(payload)
         self.transport.publish_params(self.version, payload)
         self.last_publish_s = time.monotonic() - t0
+
+    def _zw_reader(self):
+        """Reader-mode sharded-params assembler for non-leader processes
+        (owns nothing; fetch() gathers the newest consistent round)."""
+        if self._zw_rd is None:
+            from ps_pytorch_tpu.parallel.zero_wire import updater_from_config
+            self._zw_rd = updater_from_config(
+                self.cfg, inner=None, kv=self._kv,
+                run_id=f"zw-{self.cfg.seed}", params=self.params,
+                members=[0], me=None, n_shards=max(self.n, 2))
+        return self._zw_rd
+
+    def _fetch_canonical(self, min_version: int = -1):
+        """(version, {"params", "bs0"}) from the canonical plane. Normal
+        runs read the transport publish; under --shard-wire params
+        assemble from the per-shard keys (pipelined) and only the BN
+        stats ride the transport (their version may lag a publish_every
+        window behind the params — eval-only state, same skew the
+        replicated path has between publishes)."""
+        if not self.cfg.shard_wire:
+            got = self.transport.fetch_params()
+            return None if got is None or got[0] <= min_version else got
+        got = self._zw_reader().fetch(min_version)
+        if got is None:
+            return None
+        version, params = got
+        bs = self.transport.fetch_params()
+        bs0 = bs[1]["bs0"] if bs is not None else self._bs0()
+        return version, {"params": params, "bs0": bs0}
 
     def _compute_and_submit(self, version_used: int) -> dict:
         with self.tracer.span("data_wait", step=self._seq + 1):
@@ -724,10 +814,22 @@ class AsyncTrainer:
         avg, pool = self.aggregator.collect(self.version)
         used = 0
         if avg is not None and pool["used"]:
-            # Update runs jitted with everything already device-resident;
-            # only the pooled average crosses host->device here.
-            self.params, self.opt_state = self._update(
-                self.params, self.opt_state, avg)
+            if self.cfg.shard_wire:
+                # Sharded host-side update: per-shard optimizer + pipelined
+                # per-shard publish + assemble (parallel/zero_wire.py). The
+                # per-shard keys ARE the canonical publish for params, so
+                # _publish_canonical ships only BN stats below.
+                self.params = jax.device_put(
+                    self.aggregator.update_from(avg,
+                                                version=self.version + 1),
+                    self._rep)
+                self._zw_ptr_version = self.version + 1
+            else:
+                # Update runs jitted with everything already
+                # device-resident; only the pooled average crosses
+                # host->device here.
+                self.params, self.opt_state = self._update(
+                    self.params, self.opt_state, avg)
             self.version += 1
             self.applied += 1
             used = len(pool["used"])
@@ -757,7 +859,7 @@ class AsyncTrainer:
             # first blocking step-fetch, distributed_worker.py:193-199).
             deadline = time.monotonic() + 120.0
             while True:
-                got = self.transport.fetch_params()
+                got = self._fetch_canonical()
                 if got is not None:
                     my_version, tree = got
                     self.params = jax.device_put(tree["params"], self._rep)
@@ -855,7 +957,7 @@ class AsyncTrainer:
                 # its contributions carry the true current version.
                 my_version = self.version
             elif own_steps % self.fetch_every == 0:
-                got = self.transport.fetch_params()
+                got = self._fetch_canonical(my_version)
                 if got is not None and got[0] > my_version:
                     my_version, tree = got
                     # ONE host->device transfer per fetch; the jitted grad fn
@@ -946,7 +1048,7 @@ class AsyncTrainer:
         leader's replica-0 BN stats from the final publish — so all FINAL
         lines agree even for BN networks. The reference evaluator likewise
         scores the master's checkpoint."""
-        got = self.transport.fetch_params()
+        got = self._fetch_canonical()
         if got is not None:
             params, bs0 = got[1]["params"], got[1]["bs0"]
         else:

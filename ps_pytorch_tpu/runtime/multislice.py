@@ -136,6 +136,19 @@ class MultiSliceTrainer:
                 wire_workers=cfg.wire_workers,
                 topk_frac=cfg.grad_topk_frac, error_feedback=cfg.ef,
                 ef_clip=cfg.ef_clip)
+        if cfg.shard_wire:
+            # ZeRO-over-the-wire (parallel/zero_wire.py): same pool surface
+            # (submit/collect/... delegate to the aggregator above,
+            # decision-identical), but the canonical update is sharded —
+            # applied host-side per bucket-edge-snapped shard, published
+            # per shard over the KV, re-assembled pipelined. Single-owner
+            # here (one process), which still exercises the per-shard wire.
+            from ps_pytorch_tpu.parallel.zero_wire import updater_from_config
+            from ps_pytorch_tpu.runtime.coordinator import KVStore
+            self.aggregator = updater_from_config(
+                cfg, inner=self.aggregator, kv=KVStore(),
+                run_id=f"zw-{cfg.seed}", params=self.params,
+                members=[0], me=0, n_shards=max(n_slices, 2))
         from ps_pytorch_tpu.data.augment import input_norm_for
         self._input_norm = input_norm_for(cfg)
         self.grad_fns = [make_slice_grad_fn(self.model, m, self.has_bn,
@@ -221,8 +234,13 @@ class MultiSliceTrainer:
             # jitted update or it fails with incompatible devices.
             from ps_pytorch_tpu.parallel.async_dp import colocate_tree
             avg = colocate_tree(avg, self.params)
-            self.params, self.opt_state = self._update(
-                self.params, self.opt_state, avg)
+            if self.cfg.shard_wire:
+                # Sharded host-side update + per-shard publish/assemble.
+                self.params = jax.device_put(
+                    self.aggregator.update_from(avg, version=self.step))
+            else:
+                self.params, self.opt_state = self._update(
+                    self.params, self.opt_state, avg)
             self.applied += 1
             self.aggregator.consume(pool["used"])
         # GC every tick (collect only reports; unremoved entries would be
@@ -255,6 +273,12 @@ class MultiSliceTrainer:
         # checkpoint carries them as extra state whenever EF is on.
         extra = {"ef": self.aggregator.ef_state_dict()} \
             if (self.cfg.ef or self.cfg.sync_topology == "hier") else None
+        if self.cfg.shard_wire:
+            # Sharded optimizer state (per-shard concatenated fields +
+            # step) — without it a resumed run restarts momentum/Adam
+            # moments from zero and diverges from the uninterrupted run.
+            extra = dict(extra or {})
+            extra["zero"] = self.aggregator.state_dict()
         ckpt.save_checkpoint(self.cfg.train_dir, self.step,
                              jax.device_get(self._as_train_state()),
                              config_json=self.cfg.to_json(),
@@ -285,6 +309,12 @@ class MultiSliceTrainer:
         extra = ckpt.load_extra_state(self.cfg.train_dir, step)
         if extra and "ef" in extra:
             self.aggregator.load_ef_state(extra["ef"])
+        if self.cfg.shard_wire and extra and "zero" in extra:
+            # Bit-for-bit resume: re-anchor owned param shards from the
+            # restored canonical params, then restore the sharded
+            # optimizer moments + step.
+            self.aggregator.load_state_dict(extra["zero"],
+                                            params=self.params)
         print(f"RESUME from {ckpt.checkpoint_path(self.cfg.train_dir, step)} "
               f"at step {self.step}")
         return True

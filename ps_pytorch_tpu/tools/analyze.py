@@ -51,6 +51,15 @@ and publish-level totals — how much of the wire cut each bucket earns:
     python -m ps_pytorch_tpu.tools.analyze codec /tmp/wire_spans.jsonl
     python -m ps_pytorch_tpu.tools.analyze codec trace.json --json
 
+Zero mode reads the same span timelines from a --shard-wire run
+(parallel/zero_wire.py stamps zw_publish/zw_update/zw_put/zw_assemble/
+zw_get) and breaks the sharded weight update down: per-shard update/put/
+get seconds + bytes and the publish/assemble overlap fractions — how much
+of the per-shard KV wait the worker pool actually hid:
+
+    python -m ps_pytorch_tpu.tools.analyze zero /tmp/zw_spans.jsonl
+    python -m ps_pytorch_tpu.tools.analyze zero trace.json --json
+
 Flight mode renders a flight-recorder crash dump (telemetry/flightrec.py)
 as a post-mortem: health events, recent steps/spans/events, and the final
 metric snapshot. Stitch mode merges per-process Chrome traces into one and
@@ -434,6 +443,97 @@ def wire_main(args, parser) -> int:
         print(json.dumps(summary))
     else:
         print(wire_markdown(summary))
+    return 0
+
+
+# ---- zero mode (ZeRO-over-the-wire span timeline) ----
+
+def zero_summary(events: List[dict]) -> dict:
+    """zw_* spans (parallel/zero_wire.py) -> per-shard publish/read byte
+    accounting and overlap fractions.
+
+    publish overlap = 1 - zw_publish wall / (zw_update + zw_put serial):
+    the per-shard KV puts ride the worker pool while the next shard's
+    host update runs, so ->1 means the wire wait was hidden behind
+    compute. assemble overlap is the same over zw_assemble vs its
+    zw_get legs (foreign shards fetched pool-parallel)."""
+    stages: Dict[str, dict] = {}
+    per_shard: Dict[int, dict] = {}
+    for e in events:
+        name = e["name"]
+        if not name.startswith("zw_"):
+            continue
+        st = stages.setdefault(name, {"count": 0, "total_s": 0.0, "bytes": 0})
+        st["count"] += 1
+        st["total_s"] += e["dur"]
+        args = e.get("args") or {}
+        if "bytes" in args:
+            st["bytes"] += int(args["bytes"])
+        if "shard" in args and name in ("zw_put", "zw_get", "zw_update"):
+            s = per_shard.setdefault(int(args["shard"]),
+                                     {"shard": int(args["shard"]),
+                                      "update_s": 0.0, "put_s": 0.0,
+                                      "get_s": 0.0, "put_bytes": 0,
+                                      "get_bytes": 0})
+            s[name[len("zw_"):] + "_s"] += e["dur"]
+            if "bytes" in args:
+                s[f"{name[len('zw_'):]}_bytes"] += int(args["bytes"])
+    for st in stages.values():
+        st["total_s"] = round(st["total_s"], 6)
+
+    def frac(wall: float, serial: float):
+        if wall <= 0 or serial <= 0:
+            return None
+        return round(max(0.0, 1.0 - wall / serial), 4)
+
+    pub_wall = stages.get("zw_publish", {}).get("total_s", 0.0)
+    pub_serial = (stages.get("zw_update", {}).get("total_s", 0.0)
+                  + stages.get("zw_put", {}).get("total_s", 0.0))
+    asm_wall = stages.get("zw_assemble", {}).get("total_s", 0.0)
+    asm_serial = stages.get("zw_get", {}).get("total_s", 0.0)
+    return {"stages": {k: stages[k] for k in sorted(stages)},
+            "shards": [dict(per_shard[k],
+                            update_s=round(per_shard[k]["update_s"], 6),
+                            put_s=round(per_shard[k]["put_s"], 6),
+                            get_s=round(per_shard[k]["get_s"], 6))
+                       for k in sorted(per_shard)],
+            "publish_overlap_fraction": frac(pub_wall, pub_serial),
+            "assemble_overlap_fraction": frac(asm_wall, asm_serial)}
+
+
+def zero_markdown(summary: dict) -> str:
+    lines = ["| stage | count | total | bytes |", "|---|---|---|---|"]
+    for name, st in summary["stages"].items():
+        lines.append(f"| {name} | {st['count']} | {st['total_s']:.6f} s "
+                     f"| {st['bytes']} |")
+    if summary["shards"]:
+        lines += ["", "| shard | update | put | get | put bytes | get bytes |",
+                  "|---|---|---|---|---|---|"]
+        for s in summary["shards"]:
+            lines.append(f"| {s['shard']} | {s['update_s']:.6f} s "
+                         f"| {s['put_s']:.6f} s | {s['get_s']:.6f} s "
+                         f"| {s['put_bytes']} | {s['get_bytes']} |")
+    for side in ("publish", "assemble"):
+        v = summary[f"{side}_overlap_fraction"]
+        lines.append(f"\n{side} overlap fraction: "
+                     + ("n/a (no pipelined sub-spans)" if v is None
+                        else f"{v:.4f}"))
+    return "\n".join(lines)
+
+
+def zero_main(args, parser) -> int:
+    files: List[str] = []
+    for pattern in args.runs:
+        files.extend(sorted(glob.glob(pattern)) or
+                     parser.error(f"no files match {pattern!r}") or [])
+    events = [e for path in files for e in read_span_events(path)]
+    if not any(e["name"].startswith("zw_") for e in events):
+        parser.error(f"no zw_* spans in {files}")
+    summary = zero_summary(events)
+    if args.json:
+        print(json.dumps(summary))
+    else:
+        print(zero_markdown(summary))
     return 0
 
 
@@ -927,6 +1027,9 @@ def main(argv=None) -> int:
     if args.runs[0] == "codec":
         args.runs = args.runs[1:] or p.error("codec mode needs FILE...")
         return codec_main(args, p)
+    if args.runs[0] == "zero":
+        args.runs = args.runs[1:] or p.error("zero mode needs FILE...")
+        return zero_main(args, p)
     if args.runs[0] == "serving":
         args.runs = args.runs[1:] or p.error("serving mode needs FILE...")
         return serving_main(args, p)

@@ -46,6 +46,14 @@ Families and their watched metrics (direction, relative tolerance):
                                         screened run's final loss matched
                                         the clean baseline, and the digest+
                                         screen overhead stayed < 2%
+- ``zero_wire``  BENCH_ZERO_r*.json     zero_wire_win_* rows: per-row ok,
+                                        bitwise_identical (sharded final
+                                        params == replicated, exactly),
+                                        per-replica publish bytes <= 0.75x
+                                        the full-pytree publish, optimizer
+                                        state <= 1/N + 0.15 per replica
+                                        (bars travel in the artifact; no
+                                        prior round needed)
 - ``kvrep``      RESILIENCE_r*.json     newest artifact WITH a "kvrep"
                                         section: the coordination-plane
                                         drill (tools/kvrep_drill.py) saw a
@@ -190,6 +198,17 @@ FAMILIES: Dict[str, dict] = {
                           ("wire_integrity_failures", 1)],
         "absolute": [("overhead_frac", 0.02)],
     },
+    "zero_wire": {
+        # ZeRO-over-the-wire artifact (bench_suite zero_wire_* rows +
+        # derived zero_wire_win_*): every win row must be ok AND bitwise-
+        # identical to the 1shard replicated baseline, per-replica publish
+        # bytes must stay <= 0.75x the full-pytree publish, and the
+        # per-replica optimizer state must stay ~1/N. The bars travel in
+        # the rows, so the gate needs no prior round.
+        "pattern": "BENCH_ZERO_r[0-9]*.json",
+        "metrics": [],              # invariant check, see _check_zero_wire
+        "max_ratio": [("wire_out_ratio", 0.75)],
+    },
     "kvrep": {
         # Same artifact series, gating the coordination-plane drill
         # (tools/kvrep_drill.py): the newest RESILIENCE_r*.json carrying a
@@ -280,6 +299,8 @@ def compare(family: str, baseline, candidate) -> dict:
         return _check_slo(spec, candidate)
     if family == "wire_codec":
         return _check_wire_codec(spec, candidate)
+    if family == "zero_wire":
+        return _check_zero_wire(spec, candidate)
     base_rows, cand_rows = _by_config(baseline), _by_config(candidate)
     configs: Dict[str, dict] = {}
     ok = True
@@ -395,6 +416,39 @@ def _check_wire_codec(spec: dict, candidate) -> dict:
         configs[name]["ok"] = configs[name]["ok"] and check["ok"]
         ok = ok and check["ok"]
     return {"family": "wire_codec", "ok": ok, "configs": configs}
+
+
+def _check_zero_wire(spec: dict, candidate) -> dict:
+    """Gate the ZeRO-over-the-wire win rows: each row's own ok bit, the
+    bitwise sharded==replicated flag, the per-replica wire-byte ceiling,
+    and the ~1/N optimizer-memory ceiling (N travels in the row)."""
+    rows = _by_config(candidate)
+    win_rows = {n: r for n, r in rows.items()
+                if n.startswith("zero_wire_win_")}
+    configs: Dict[str, dict] = {}
+    ok = True
+    if not win_rows:
+        return {"family": "zero_wire", "ok": False,
+                "configs": {"_empty": {"ok": False,
+                                       "note": "no zero_wire_win_* rows"}}}
+    for name, row in sorted(win_rows.items()):
+        n = max(int(row.get("shards", 0)), 1)
+        checks = {
+            "ok": {"cand": row.get("ok"), "ok": row.get("ok") is True},
+            "bitwise_identical": {"cand": row.get("bitwise_identical"),
+                                  "ok": row.get("bitwise_identical") is True},
+            "opt_state_ratio": {"cand": row.get("opt_state_ratio"),
+                                "ceiling": round(1.0 / n + 0.15, 3),
+                                "ok": float(row.get("opt_state_ratio", 9.9))
+                                <= 1.0 / n + 0.15},
+        }
+        for metric, ceiling in spec["max_ratio"]:
+            checks[metric] = {"cand": row.get(metric), "ceiling": ceiling,
+                              "ok": float(row.get(metric, 9.9)) <= ceiling}
+        configs[name] = {"ok": all(c["ok"] for c in checks.values()),
+                         "metrics": checks}
+        ok = ok and configs[name]["ok"]
+    return {"family": "zero_wire", "ok": ok, "configs": configs}
 
 
 def _check_resilience(spec: dict, candidate) -> dict:
@@ -624,7 +678,7 @@ def run_gate(family: str, candidate_path: str, repo: str = ".",
     against its predecessor."""
     candidate = load_artifact(candidate_path)
     baseline = None
-    if family not in ("resilience", "ops", "slo", "wire_codec",
+    if family not in ("resilience", "ops", "slo", "wire_codec", "zero_wire",
                       "hierarchy", "router", "integrity", "kvrep"):
         if baseline_path:
             baseline = load_artifact(baseline_path)
@@ -681,7 +735,7 @@ def run_all(repo: str = ".") -> dict:
                                             "wire_codec_win_* rows; skipped"}
                 continue
             families[family] = run_gate(family, with_rows[-1], repo=repo)
-        elif family in ("resilience", "ops", "slo"):
+        elif family in ("resilience", "ops", "slo", "zero_wire"):
             families[family] = run_gate(family, paths[-1], repo=repo)
         elif len(paths) < 2:
             families[family] = {"family": family, "ok": True,

@@ -337,6 +337,34 @@ def collect(repo: str):
             and all(r.get("ok") is True and r.get("bitwise_identical") is True
                     for r in wins),
             "errors": errors})
+    p = _newest("BENCH_ZERO_r[0-9]*.json", repo)
+    if p:
+        # ZeRO-over-the-wire evidence (bench_suite zero_wire_* rows +
+        # derived zero_wire_win_*): ok means every N-shard run stayed
+        # BITWISE identical to the replicated baseline while cutting
+        # per-replica publish bytes and optimizer memory to ~1/N. The
+        # headline value is the deepest shard count's wire_out_ratio.
+        rows = _load(p)
+        if isinstance(rows, dict):
+            rows = [rows]
+        rows = [r for r in rows if isinstance(r, dict)]
+        errors = [r.get("config", r.get("_parse_error", "?")) for r in rows
+                  if "error" in r or "_parse_error" in r]
+        wins = [r for r in rows
+                if str(r.get("config", "")).startswith("zero_wire_win")]
+        head = max(wins, key=lambda r: r.get("shards") or 0, default=None)
+        add("zero wire", p, {
+            "rows": len(rows),
+            "value": head.get("wire_out_ratio") if head else None,
+            "unit": "x full-pytree publish bytes/replica ({} shards)".format(
+                head.get("shards") if head else "?"),
+            "opt_state_ratio": head.get("opt_state_ratio") if head else None,
+            "platform": next((r.get("platform") for r in rows
+                              if r.get("platform")), "host"),
+            "ok": bool(wins) and not errors
+            and all(r.get("ok") is True and r.get("bitwise_identical") is True
+                    for r in wins),
+            "errors": errors})
     p = _newest("BENCH_SERVE_r[0-9]*.json", repo)
     if p:
         # Serving evidence (bench_suite serve_sequential_8/serve_batched_8 +
